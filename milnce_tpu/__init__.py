@@ -1,0 +1,18 @@
+"""milnce_tpu — a TPU-native (JAX / XLA / Pallas / pjit) framework for MIL-NCE
+video-text representation learning on HowTo100M.
+
+A ground-up redesign (not a port) of the capabilities of
+KoDohwan/MIL-NCE_HowTo100M:
+
+- ``milnce_tpu.models``   — S3D-G video tower + word2vec sentence tower (Flax).
+- ``milnce_tpu.losses``   — MIL-NCE with mesh-wide negatives, (soft-)DTW losses.
+- ``milnce_tpu.ops``      — soft-DTW Pallas TPU kernel + lax.scan golden impl,
+                            hard DTW.
+- ``milnce_tpu.parallel`` — device-mesh / sharding helpers (ICI+DCN via GSPMD).
+- ``milnce_tpu.data``     — tokenizer, MIL candidate sampling, ffmpeg host
+                            decode, synthetic sources, sharded prefetch.
+- ``milnce_tpu.train``    — jitted train step, LR schedules, Orbax checkpoints.
+- ``milnce_tpu.eval``     — retrieval metrics, zero-shot eval, linear probe.
+"""
+
+__version__ = "0.1.0"

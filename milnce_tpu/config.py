@@ -1,0 +1,193 @@
+"""Typed configuration for the whole framework.
+
+Replaces the reference's two near-duplicate argparse files (args.py:3-52,
+args_small.py:3-52) with one dataclass tree + presets.  Every knob of the
+reference CLI has a typed home here; nothing is hardcoded in library code
+(the reference leaked node IPs into train.py:48 and checkpoint paths into
+eval scripts — see SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class DataConfig:
+    """Input-pipeline knobs (reference: args.py:5-7,14,16,22-26,29,32)."""
+
+    train_csv: str = ""                 # manifest csv with a `video_path` column
+    video_root: str = ""
+    caption_root: str = ""
+    eval_video_root: str = ""
+    fps: int = 10
+    num_frames: int = 32
+    video_size: int = 224
+    crop_only: bool = True
+    center_crop: bool = False
+    random_flip: bool = True
+    min_time: float = 5.0
+    max_words: int = 20
+    num_candidates: int = 5             # MIL candidate captions per clip
+    num_reader_threads: int = 20        # host-side decode workers per process
+    prefetch_depth: int = 2             # device prefetch buffer (batches)
+    synthetic: bool = False             # hermetic in-memory source (no ffmpeg)
+    synthetic_num_samples: int = 256
+
+
+@dataclass
+class ModelConfig:
+    """S3D-G + sentence tower (reference: s3dg.py:207-263)."""
+
+    embedding_dim: int = 512            # args.py `--num_class`
+    gating: bool = True
+    space_to_depth: bool = False
+    weight_init: str = "uniform"        # 'uniform' (framework default) | 'kaiming_normal'
+    vocab_size: int = 66250             # s3dg.py:152
+    word_embedding_dim: int = 300
+    text_hidden_dim: int = 2048
+    text_max_words: int = 16            # s3dg.py:155 (train loader uses DataConfig.max_words)
+    word2vec_path: str = ""             # .npy/.npz table; '' = trainable-from-scratch table
+    token_dict_path: str = ""           # dict.npy vocab for the tokenizer
+    sync_batchnorm: bool = False        # cross-replica BN (original TPU run); False = local
+                                        # BN for parity with the GPU reference (README.md:13)
+    dtype: str = "float32"              # activation dtype ('bfloat16' for MXU speed)
+
+
+@dataclass
+class LossConfig:
+    """Loss selection + hyperparams (reference: loss.py)."""
+
+    name: str = "milnce"                # milnce | cdtw | sdtw_cidm | sdtw_negative | sdtw_3
+    sdtw_gamma: float = 0.1             # loss.py:38,74,97 (cdtw uses 1e-5, loss.py:26)
+    sdtw_dist: str = "cosine"           # cosine | negative_dot | negative_cosine | euclidean
+    sdtw_bandwidth: int = 0             # Sakoe-Chiba band; 0 = off
+    cidm_sigma: float = 10.0            # loss.py:58
+    cidm_lambda: float = 1.0            # loss.py:57
+
+
+@dataclass
+class OptimConfig:
+    """Optimizer + schedule (reference: args.py:12,20,28,34,36-37; utils.py:26-38)."""
+
+    name: str = "adam"                  # adam | sgd
+    lr: float = 1e-3
+    momentum: float = 0.9
+    warmup_steps: int = 50_000
+    epochs: int = 300
+    num_cycles: float = 0.5
+
+
+@dataclass
+class ParallelConfig:
+    """Mesh layout. Replaces NCCL/TCP rendezvous + mp.spawn (main_distributed.py:50-75)
+    with `jax.distributed.initialize` + one GSPMD program over a named mesh."""
+
+    data_axis: str = "data"             # batch-sharded axis (DP + global negatives)
+    model_axis: Optional[str] = None    # optional TP axis (S3D is small; off by default)
+    model_parallel_size: int = 1
+    coordinator_address: Optional[str] = None   # multi-host bootstrap (None = single host)
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+
+
+@dataclass
+class TrainConfig:
+    batch_size: int = 128               # GLOBAL batch (reference splits per GPU at
+                                        # main_distributed.py:88; we shard over the mesh)
+    batch_size_val: int = 32
+    seed: int = 1
+    n_display: int = 400
+    checkpoint_root: str = "checkpoint"
+    checkpoint_dir: str = ""
+    checkpoint_keep: int = 10           # sliding retention (main_distributed.py:289-294)
+    log_root: str = "log"
+    resume: bool = False
+    pretrain_ckpt: str = ""             # load converted weights before training
+    evaluate: bool = False
+    num_windows_test: int = 4
+    verbose: bool = True
+
+
+@dataclass
+class Config:
+    data: DataConfig = field(default_factory=DataConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    loss: LossConfig = field(default_factory=LossConfig)
+    optim: OptimConfig = field(default_factory=OptimConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+
+
+def full_preset() -> Config:
+    """Defaults of the reference full run (args.py)."""
+    return Config()
+
+
+def small_preset() -> Config:
+    """Scaled-down run: the args_small.py deltas (batch 12, warmup 1000,
+    100 epochs, 16 frames) made actually runnable — the reference's
+    train_small.py is import-broken (SURVEY.md §2.4)."""
+    cfg = Config()
+    cfg.train.batch_size = 12
+    cfg.optim.warmup_steps = 1000
+    cfg.optim.epochs = 100
+    cfg.data.num_frames = 16
+    cfg.data.video_size = 128
+    cfg.data.num_candidates = 1
+    return cfg
+
+
+def tiny_preset() -> Config:
+    """Hermetic CPU/CI preset: synthetic data, tiny shapes, no external files."""
+    cfg = small_preset()
+    cfg.data.synthetic = True
+    cfg.data.num_frames = 4
+    cfg.data.video_size = 32
+    cfg.data.max_words = 6
+    cfg.train.batch_size = 4
+    cfg.model.vocab_size = 128
+    cfg.optim.warmup_steps = 2
+    cfg.optim.epochs = 1
+    cfg.train.n_display = 1
+    return cfg
+
+
+PRESETS = {"full": full_preset, "small": small_preset, "tiny": tiny_preset}
+
+
+def _add_dataclass_args(parser: argparse.ArgumentParser, prefix: str, dc) -> None:
+    import typing
+
+    hints = typing.get_type_hints(type(dc))
+    for f in dataclasses.fields(dc):
+        typ = hints[f.name]
+        if typing.get_origin(typ) is typing.Union:   # Optional[T] -> T
+            typ = next(a for a in typing.get_args(typ) if a is not type(None))
+        name = f"--{prefix}{f.name}"
+        if typ is bool:
+            parser.add_argument(name, type=lambda s: s.lower() in ("1", "true", "yes"),
+                                default=None, metavar="BOOL")
+        elif typ in (int, float, str):
+            parser.add_argument(name, type=typ, default=None)
+
+
+def parse_cli(argv: Optional[list[str]] = None, description: str = "milnce-tpu") -> Config:
+    """CLI front-end: `--preset {full,small,tiny}` then per-field overrides
+    like `--train.batch_size 256` / `--optim.lr 1e-3`."""
+    parser = argparse.ArgumentParser(description=description)
+    parser.add_argument("--preset", choices=sorted(PRESETS), default="full")
+    base = Config()
+    for section in dataclasses.fields(base):
+        _add_dataclass_args(parser, f"{section.name}.", getattr(base, section.name))
+    ns = parser.parse_args(argv)
+    cfg = PRESETS[ns.preset]()
+    for key, val in vars(ns).items():
+        if key == "preset" or val is None:
+            continue
+        section, _, fname = key.partition(".")
+        setattr(getattr(cfg, section), fname, val)
+    return cfg

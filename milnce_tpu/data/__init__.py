@@ -1,0 +1,1 @@
+from milnce_tpu.data.tokenizer import Tokenizer  # noqa: F401

@@ -1,0 +1,3 @@
+from milnce_tpu.models.s3dg import S3D, InceptionBlock, STConv3D, SelfGating  # noqa: F401
+from milnce_tpu.models.text import SentenceEmbedding  # noqa: F401
+from milnce_tpu.models.build import build_model  # noqa: F401

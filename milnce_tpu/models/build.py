@@ -1,0 +1,46 @@
+"""Model factory: ModelConfig -> S3D module (+ optional pretrained word2vec).
+
+Replaces the reference's constructor-side file IO (s3dg.py:235-238, where the
+model loads word2vec.pth and dict.npy itself): file loading lives here, the
+module stays pure.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from milnce_tpu.config import ModelConfig
+from milnce_tpu.models.s3dg import S3D
+from milnce_tpu.models.text import word2vec_embedding_init
+
+
+def load_word2vec_table(path: str) -> np.ndarray:
+    """Load a pretrained (V, 300) embedding table from .npy/.npz."""
+    if path.endswith(".npz"):
+        with np.load(path) as z:
+            return z[list(z.files)[0]]
+    return np.load(path)
+
+
+def build_model(cfg: ModelConfig, bn_axis_name: str | None = None) -> S3D:
+    embedding_init = None
+    vocab_size = cfg.vocab_size
+    if cfg.word2vec_path and os.path.exists(cfg.word2vec_path):
+        table = load_word2vec_table(cfg.word2vec_path)
+        vocab_size = table.shape[0]
+        embedding_init = word2vec_embedding_init(table)
+    return S3D(
+        num_classes=cfg.embedding_dim,
+        gating=cfg.gating,
+        use_space_to_depth=cfg.space_to_depth,
+        vocab_size=vocab_size,
+        word_embedding_dim=cfg.word_embedding_dim,
+        text_hidden_dim=cfg.text_hidden_dim,
+        weight_init=cfg.weight_init,
+        bn_axis_name=bn_axis_name if cfg.sync_batchnorm else None,
+        embedding_init=embedding_init,
+        dtype=jnp.dtype(cfg.dtype),
+    )

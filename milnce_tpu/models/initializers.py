@@ -1,0 +1,42 @@
+"""Weight initializers.
+
+The reference's ``init='uniform'`` is the torch default (kaiming-uniform with
+a=sqrt(5), i.e. U(±sqrt(3/ (3*fan_in)))); ``init='kaiming_normal'`` is
+``nn.init.kaiming_normal_(mode='fan_in', nonlinearity='relu')``
+(s3dg.py:240-246).  We expose both as JAX initializers.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import nn as jnn
+from jax.nn import initializers as init
+
+
+def torch_default_kernel():
+    """torch's Conv/Linear default: kaiming_uniform(a=sqrt(5)) == uniform
+    variance scaling with gain 1/3."""
+    return init.variance_scaling(1.0 / 3.0, "fan_in", "uniform")
+
+
+def kaiming_normal_kernel():
+    """kaiming_normal_(mode='fan_in', nonlinearity='relu'): N(0, 2/fan_in)."""
+    return init.variance_scaling(2.0, "fan_in", "normal")
+
+
+def torch_bias(fan_in: int):
+    """torch default bias: U(±1/sqrt(fan_in))."""
+    bound = 1.0 / (fan_in ** 0.5)
+
+    def _init(key, shape, dtype=jnp.float32):
+        import jax.random as jr
+
+        return jr.uniform(key, shape, dtype, -bound, bound)
+
+    return _init
+
+
+def kernel_init_for(name: str):
+    if name == "kaiming_normal":
+        return kaiming_normal_kernel()
+    return torch_default_kernel()

@@ -1,0 +1,62 @@
+"""Sentence tower: frozen word2vec embedding -> MLP -> max-pool over words.
+
+Re-design of the reference Sentence_Embedding (/root/reference/s3dg.py:148-204):
+lookup under no_grad (:199-200) becomes ``lax.stop_gradient``; the tokenizer
+that the reference bundles into the model moves to ``milnce_tpu.data.tokenizer``
+(host-side, where tokenization actually runs).
+
+The max over the word axis includes pad positions (id 0), exactly like the
+reference's ``th.max(x, dim=1)`` (s3dg.py:202) — row 0 of the embedding table
+participates.  Checkpoint conversion must therefore keep row 0 intact.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class SentenceEmbedding(nn.Module):
+    embd_dim: int = 512
+    vocab_size: int = 66250
+    word_embedding_dim: int = 300
+    hidden_dim: int = 2048
+    embedding_init: Optional[Callable] = None  # e.g. from a word2vec table
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        """tokens: (B, max_words) int -> (B, embd_dim)."""
+        emb_init = self.embedding_init or nn.initializers.normal(stddev=1.0)
+        table = nn.Embed(self.vocab_size, self.word_embedding_dim,
+                         embedding_init=emb_init, dtype=self.dtype,
+                         name="word_embd")
+        from milnce_tpu.models.initializers import torch_bias, torch_default_kernel
+
+        x = jax.lax.stop_gradient(table(tokens))     # frozen, s3dg.py:199-200
+        # Linears keep torch-default init in every init mode (the
+        # reference's kaiming branch only touches convs/BN, s3dg.py:240-246).
+        x = nn.relu(nn.Dense(self.hidden_dim, kernel_init=torch_default_kernel(),
+                             bias_init=torch_bias(self.word_embedding_dim),
+                             dtype=self.dtype, name="fc1")(x))
+        x = jnp.max(x, axis=1)                       # max-pool over words
+        return nn.Dense(self.embd_dim, kernel_init=torch_default_kernel(),
+                        bias_init=torch_bias(self.hidden_dim),
+                        dtype=self.dtype, name="fc2")(x)
+
+
+def word2vec_embedding_init(table) -> Callable:
+    """Build an embedding_init closing over a pretrained (V, 300) table."""
+    import numpy as np
+
+    table = np.asarray(table)
+
+    def _init(key, shape, dtype=jnp.float32):
+        assert tuple(shape) == table.shape, (shape, table.shape)
+        return jnp.asarray(table, dtype=dtype)
+
+    return _init

@@ -1,0 +1,96 @@
+"""Hard (exact) DTW with path backtracking — eval-only utility.
+
+Re-design of the reference dtw.py:5-75 (python cell loops on GPU tensors)
+as a skewed `lax.scan` DP + a `fori_loop` backtrack, fully jittable.
+
+Loss semantics (dtw.py:73-75): with the optimal path P,
+``logsumexp_j(sum_i cost*P) - logsumexp_j(sum_i cost)``; the min/backtrack
+is detached so gradients flow through the cost only (dtw.py:52).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from milnce_tpu.ops.softdtw import BIG, skew_cost, _cosine_sim
+
+
+def dtw_table(cost: jax.Array) -> jax.Array:
+    """(B, N, M) cost -> (B, N, M) accumulated-cost table
+    tc[i,j] = cost[i,j] + min(tc[i-1,j-1], tc[i-1,j], tc[i,j-1])."""
+    bsz, n, m = cost.shape
+    d_skew = skew_cost(cost)
+    i_buf = jnp.arange(n + 1)
+    init_mm = jnp.full((bsz, n + 1), BIG, cost.dtype).at[:, 0].set(0.0)
+    init_m = jnp.full((bsz, n + 1), BIG, cost.dtype)
+
+    def step(carry, inputs):
+        r_mm, r_m = carry
+        cost_row, p = inputs
+        best = jnp.minimum(jnp.minimum(r_mm[:, :-1], r_m[:, :-1]), r_m[:, 1:])
+        r_new = jnp.concatenate(
+            [jnp.full((bsz, 1), BIG, cost.dtype), cost_row + best], axis=1)
+        j_buf = p - i_buf
+        valid = (i_buf >= 1) & (j_buf >= 1) & (j_buf <= m)
+        r_new = jnp.where(valid[None], r_new, BIG)
+        return (r_m, r_new), r_new
+
+    diag_ids = jnp.arange(2, n + m + 1)
+    _, diags = lax.scan(step, (init_mm, init_m),
+                        (d_skew.transpose(1, 0, 2), diag_ids))
+    # un-skew: tc[i, j] lives at diags[i + j, i + 1]
+    i_idx = jnp.arange(n)[:, None]
+    j_idx = jnp.arange(m)[None, :]
+    return diags[i_idx + j_idx, :, i_idx + 1].transpose(2, 0, 1)
+
+
+def dtw_path(cost: jax.Array) -> jax.Array:
+    """Backtrack the optimal alignment path (reference dtw.py:56-72),
+    stopping at the first border hit, always marking (0, 0).
+
+    The reference picks the predecessor by exact float equality
+    (tc[i,j] - cost[i,j] == tc[pred]) and prints 'error' when rounding
+    makes none match (dtw.py:60-71); we pick argmin of the three
+    predecessors (diag preferred on ties, like the reference's check
+    order), which is the same path in exact arithmetic and robust in
+    float32."""
+    tc = dtw_table(cost)
+    bsz, n, m = cost.shape
+
+    def one(tc_b, cost_b):
+        path = jnp.zeros((n, m), cost.dtype).at[n - 1, m - 1].set(1.0)
+
+        def body(_, state):
+            i, j, path, stopped = state
+            stalled = (i == 0) | (j == 0)
+            p_diag = tc_b[i - 1, j - 1]
+            p_up = tc_b[i - 1, j]
+            p_left = tc_b[i, j - 1]
+            best = jnp.minimum(jnp.minimum(p_diag, p_up), p_left)
+            take_diag = p_diag == best
+            take_up = (~take_diag) & (p_up == best)
+            ni = jnp.where(take_diag | take_up, i - 1, i)
+            nj = jnp.where(take_diag, j - 1, jnp.where(take_up, j, j - 1))
+            move = ~(stopped | stalled)
+            ni = jnp.where(move, ni, i)
+            nj = jnp.where(move, nj, j)
+            path = jnp.where(move, path.at[ni, nj].set(1.0), path)
+            return ni, nj, path, stopped | stalled
+
+        _, _, path, _ = lax.fori_loop(0, n + m, body,
+                                      (n - 1, m - 1, path, False))
+        return path.at[0, 0].set(1.0)
+
+    return jax.vmap(one)(tc, cost)
+
+
+def dtw_loss(x: jax.Array, y: jax.Array) -> jax.Array:
+    """Reference DTW.forward (dtw.py:22-75) on cosine distance:
+    x, y: (B, N, D), (B, M, D) -> (B,)."""
+    cost = 1.0 - _cosine_sim(x, y, 1e-8)
+    path = lax.stop_gradient(dtw_path(cost))
+    pos = jax.nn.logsumexp(jnp.sum(cost * path, axis=1), axis=1)
+    neg = jax.nn.logsumexp(jnp.sum(cost, axis=1), axis=1)
+    return pos - neg

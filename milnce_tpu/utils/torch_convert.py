@@ -1,0 +1,86 @@
+"""Convert reference PyTorch S3D checkpoints -> Flax variables.
+
+Handles both checkpoint flavors the reference eval scripts accept
+(eval_msrvtt.py:21-32):
+
+- this-repo DDP format: ``{'state_dict': {'module.<name>': tensor}}``
+- upstream flat S3D_HowTo100M format: ``{'<name>': tensor}`` (used with
+  ``space_to_depth=True``).
+
+Torch is NOT imported here; callers pass a ``Mapping[str, np.ndarray]``
+(e.g. ``{k: v.numpy() for k, v in torch.load(p).items()}``), keeping the
+library torch-free.
+
+Layout rules:
+- Conv3d  ``(O, I, t, h, w)`` -> flax Conv ``(t, h, w, I, O)``
+- Linear  ``(O, I)``          -> flax Dense ``(I, O)``
+- Embedding row-major         -> unchanged
+- BatchNorm weight/bias -> params scale/bias; running_mean/var -> batch_stats.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, MutableMapping
+
+import numpy as np
+
+
+def _set(tree: MutableMapping, path: list[str], value: np.ndarray) -> None:
+    node = tree
+    for p in path[:-1]:
+        node = node.setdefault(p, {})
+    node[path[-1]] = value
+
+
+def strip_ddp_prefix(state_dict: Mapping[str, np.ndarray]) -> dict[str, np.ndarray]:
+    return {k.removeprefix("module."): v for k, v in state_dict.items()}
+
+
+def torch_state_dict_to_flax(state_dict: Mapping[str, np.ndarray]) -> dict:
+    """Return ``{'params': ..., 'batch_stats': ...}`` nested dicts matching
+    ``milnce_tpu.models.S3D``."""
+    sd = strip_ddp_prefix(state_dict)
+    params: dict = {}
+    stats: dict = {}
+    for key, raw in sd.items():
+        if key.endswith("num_batches_tracked"):
+            continue
+        val = np.asarray(raw)
+        parts = key.split(".")
+        leaf = parts[-1]
+        mods = parts[:-1]
+        # Rename the STConv3D internals: conv1/bn1 (+conv2/bn2 when separable)
+        # -> conv/bn or conv_spatial/bn_spatial + conv_temporal/bn_temporal.
+        renamed: list[str] = []
+        for i, m in enumerate(mods):
+            if m in ("conv1", "bn1", "conv2", "bn2") and i == len(mods) - 1:
+                prefix = ".".join(mods[:i])
+                separable = f"{prefix}.conv2.weight" in sd
+                if separable:
+                    m = {"conv1": "conv_spatial", "bn1": "bn_spatial",
+                         "conv2": "conv_temporal", "bn2": "bn_temporal"}[m]
+                else:
+                    m = {"conv1": "conv", "bn1": "bn"}[m]
+            renamed.append(m)
+        mods = renamed
+
+        is_bn = mods and mods[-1].startswith("bn")
+        if is_bn and leaf in ("running_mean", "running_var"):
+            _set(stats, mods + [{"running_mean": "mean", "running_var": "var"}[leaf]], val)
+        elif is_bn:
+            _set(params, mods + [{"weight": "scale", "bias": "bias"}[leaf]], val)
+        elif leaf == "weight":
+            if val.ndim == 5:        # Conv3d
+                _set(params, mods + ["kernel"], val.transpose(2, 3, 4, 1, 0))
+            elif val.ndim == 2:
+                if mods and mods[-1] == "word_embd":   # Embedding
+                    _set(params, mods + ["embedding"], val)
+                else:                # Linear
+                    _set(params, mods + ["kernel"], val.transpose(1, 0))
+            else:
+                raise ValueError(f"unexpected weight rank for {key}: {val.shape}")
+        elif leaf == "bias":
+            _set(params, mods + ["bias"], val)
+        else:
+            raise ValueError(f"unrecognized checkpoint entry: {key}")
+    return {"params": params, "batch_stats": stats}

@@ -1,0 +1,17 @@
+"""Test bootstrap: force an 8-device virtual CPU platform so every
+multi-device/sharding test runs hermetically without TPU hardware
+(SURVEY.md §4 'implication' (c))."""
+
+import os
+
+# Must run before jax initializes its backends.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The env-var route (JAX_PLATFORMS=cpu) can be overridden by accelerator
+# plugins that force their own platform list; the config update wins.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")

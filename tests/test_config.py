@@ -1,0 +1,48 @@
+"""Config presets + CLI overrides (replaces args.py/args_small.py)."""
+
+from milnce_tpu.config import parse_cli, small_preset, tiny_preset
+
+
+def test_full_defaults_match_reference_args():
+    cfg = parse_cli([])
+    # args.py defaults
+    assert cfg.train.batch_size == 128
+    assert cfg.optim.lr == 1e-3
+    assert cfg.optim.warmup_steps == 50_000
+    assert cfg.data.fps == 10
+    assert cfg.data.num_frames == 32
+    assert cfg.data.video_size == 224
+    assert cfg.data.num_candidates == 5
+    assert cfg.model.embedding_dim == 512
+
+
+def test_small_preset_deltas():
+    cfg = small_preset()
+    assert cfg.train.batch_size == 12
+    assert cfg.optim.warmup_steps == 1000
+    assert cfg.optim.epochs == 100
+    assert cfg.data.num_frames == 16
+
+
+def test_cli_overrides():
+    cfg = parse_cli(["--preset", "small", "--optim.lr", "0.01",
+                     "--train.batch_size", "64", "--data.random_flip", "false"])
+    assert cfg.optim.lr == 0.01
+    assert cfg.train.batch_size == 64
+    assert cfg.data.random_flip is False
+    assert cfg.optim.warmup_steps == 1000  # preserved from preset
+
+
+def test_optional_int_fields_parse_as_int():
+    cfg = parse_cli(["--parallel.num_processes", "4",
+                     "--parallel.process_id", "0",
+                     "--parallel.coordinator_address", "10.0.0.1:8476"])
+    assert cfg.parallel.num_processes == 4 and isinstance(cfg.parallel.num_processes, int)
+    assert cfg.parallel.process_id == 0 and isinstance(cfg.parallel.process_id, int)
+    assert cfg.parallel.coordinator_address == "10.0.0.1:8476"
+
+
+def test_tiny_preset_is_hermetic():
+    cfg = tiny_preset()
+    assert cfg.data.synthetic
+    assert cfg.train.batch_size <= 8

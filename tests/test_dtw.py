@@ -1,0 +1,68 @@
+"""Hard DTW: DP table vs numpy golden, path backtracking, loss semantics
+(behavior spec: reference dtw.py:5-75)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from milnce_tpu.ops.dtw import dtw_loss, dtw_path, dtw_table
+
+
+def numpy_dtw_table(cost):
+    B, N, M = cost.shape
+    tc = np.full((B, N, M), np.inf)
+    tc[:, 0, 0] = cost[:, 0, 0]
+    for i in range(1, N):
+        tc[:, i, 0] = tc[:, i - 1, 0] + cost[:, i, 0]
+    for j in range(1, M):
+        tc[:, 0, j] = tc[:, 0, j - 1] + cost[:, 0, j]
+    for i in range(1, N):
+        for j in range(1, M):
+            tc[:, i, j] = cost[:, i, j] + np.minimum(
+                np.minimum(tc[:, i - 1, j - 1], tc[:, i - 1, j]), tc[:, i, j - 1])
+    return tc
+
+
+def test_table_matches_numpy():
+    rng = np.random.RandomState(0)
+    cost = rng.rand(3, 6, 5).astype(np.float32)
+    got = np.asarray(dtw_table(jnp.asarray(cost)))
+    np.testing.assert_allclose(got, numpy_dtw_table(cost), rtol=1e-5)
+
+
+def test_path_on_identity_cost():
+    """Zero cost on the diagonal forces the diagonal path."""
+    n = 5
+    cost = np.ones((1, n, n), np.float32)
+    cost[0, np.arange(n), np.arange(n)] = 0.0
+    path = np.asarray(dtw_path(jnp.asarray(cost)))[0]
+    np.testing.assert_allclose(path, np.eye(n))
+
+
+def test_path_always_marks_corners():
+    rng = np.random.RandomState(1)
+    cost = rng.rand(2, 7, 4).astype(np.float32)
+    path = np.asarray(dtw_path(jnp.asarray(cost)))
+    assert (path[:, 0, 0] == 1).all()
+    assert (path[:, -1, -1] == 1).all()
+
+
+def test_loss_runs_and_differentiates():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(2, 6, 8).astype(np.float32))
+    y = jnp.asarray(rng.randn(2, 5, 8).astype(np.float32))
+    loss = dtw_loss(x, y)
+    assert loss.shape == (2,)
+    grad = jax.grad(lambda a: dtw_loss(a, y).sum())(x)
+    assert np.isfinite(np.asarray(grad)).all()
+
+
+def test_identical_sequences_give_most_negative_loss():
+    """pos - neg is minimized (most negative) when the path collects
+    near-zero cost, i.e. x == y."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(1, 6, 8).astype(np.float32)
+    same = float(dtw_loss(jnp.asarray(x), jnp.asarray(x))[0])
+    other = float(dtw_loss(jnp.asarray(x),
+                           jnp.asarray(rng.randn(1, 6, 8).astype(np.float32)))[0])
+    assert same < other

@@ -1,0 +1,125 @@
+"""S3D-G model shape/behavior tests (hermetic, CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from milnce_tpu.models import S3D
+from milnce_tpu.models.s3dg import space_to_depth, _tf_same_max_pool
+
+
+def tiny_model(**kw):
+    defaults = dict(num_classes=32, vocab_size=64, word_embedding_dim=8,
+                    text_hidden_dim=16)
+    defaults.update(kw)
+    return S3D(**defaults)
+
+
+@pytest.fixture(scope="module")
+def model_and_vars():
+    model = tiny_model()
+    video = jnp.zeros((2, 4, 32, 32, 3), jnp.float32)
+    text = jnp.zeros((2, 6), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), video, text)
+    return model, variables
+
+
+def test_forward_all_shapes(model_and_vars):
+    model, variables = model_and_vars
+    video = jnp.ones((2, 4, 32, 32, 3), jnp.float32) * 0.5
+    text = jnp.ones((4, 6), jnp.int32)  # B*K flattened rows, K=2
+    v, t = model.apply(variables, video, text)
+    assert v.shape == (2, 32)
+    assert t.shape == (4, 32)
+
+
+def test_mixed5c_features_are_1024d(model_and_vars):
+    model, variables = model_and_vars
+    video = jnp.ones((1, 4, 32, 32, 3), jnp.float32)
+    feats = model.apply(variables, video, None, mode="video", mixed5c=True)
+    assert feats.shape == (1, 1024)  # mixed_5c output dim (s3dg.py:233)
+
+
+def test_text_only_mode(model_and_vars):
+    model, variables = model_and_vars
+    out = model.apply(variables, None, jnp.zeros((3, 6), jnp.int32), mode="text")
+    assert out.shape == (3, 32)
+
+
+def test_train_mode_updates_batch_stats(model_and_vars):
+    model, variables = model_and_vars
+    video = jnp.ones((2, 4, 32, 32, 3), jnp.float32)
+    text = jnp.zeros((2, 6), jnp.int32)
+    _, mutated = model.apply(variables, video, text, train=True,
+                             mutable=["batch_stats"])
+    old = variables["batch_stats"]["conv1"]["bn"]["mean"]
+    new = mutated["batch_stats"]["conv1"]["bn"]["mean"]
+    assert not np.allclose(np.asarray(old), np.asarray(new))
+
+
+def test_gating_flag_actually_disables_gating():
+    """The reference cannot disable gating (s3dg.py:212/220 overwrite bug,
+    SURVEY.md §2.4); ours must."""
+    m = tiny_model(gating=False)
+    v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 4, 32, 32, 3)),
+               jnp.zeros((1, 6), jnp.int32))
+    flat = jax.tree_util.tree_leaves_with_path(v["params"])
+    names = ["/".join(str(k.key) for k in path) for path, _ in flat]
+    assert not any("gating" in n for n in names)
+
+
+def test_text_embedding_gradient_is_zero(model_and_vars):
+    """word2vec table is frozen via stop_gradient (s3dg.py:199-200)."""
+    model, variables = model_and_vars
+
+    def loss_fn(params):
+        out = model.apply({**variables, "params": params},
+                          None, jnp.ones((2, 6), jnp.int32), mode="text")
+        return jnp.sum(out ** 2)
+
+    grads = jax.grad(loss_fn)(variables["params"])
+    emb_grad = grads["text_module"]["word_embd"]["embedding"]
+    assert np.allclose(np.asarray(emb_grad), 0.0)
+    fc1_grad = grads["text_module"]["fc1"]["kernel"]
+    assert not np.allclose(np.asarray(fc1_grad), 0.0)
+
+
+def test_space_to_depth_layout():
+    x = jnp.arange(2 * 4 * 4 * 4 * 3, dtype=jnp.float32).reshape(2, 4, 4, 4, 3)
+    y = space_to_depth(x)
+    assert y.shape == (2, 2, 2, 2, 24)
+    # channel order is (t2, h2, w2, c): channel 0 at output (t,h,w) must be
+    # input (2t, 2h, 2w, 0)
+    np.testing.assert_allclose(y[0, 1, 1, 1, 0], x[0, 2, 2, 2, 0])
+    # last channel = (t2=1, h2=1, w2=1, c=2) -> input (2t+1, 2h+1, 2w+1, 2)
+    np.testing.assert_allclose(y[0, 0, 0, 0, 23], x[0, 1, 1, 1, 2])
+
+
+def test_space_to_depth_model_shapes():
+    m = tiny_model(use_space_to_depth=True)
+    video = jnp.zeros((1, 8, 64, 64, 3), jnp.float32)
+    text = jnp.zeros((1, 6), jnp.int32)
+    variables = m.init(jax.random.PRNGKey(0), video, text)
+    v, t = m.apply(variables, video, text)
+    assert v.shape == (1, 32)
+
+
+def _naive_ref_maxpool_1d(row, k, s):
+    """Reference MaxPool3dTFPadding semantics (s3dg.py:114-146): pad
+    max(k-s,0) low-first, then ceil-mode pooling (zero pad; inputs >=0)."""
+    pad_along = max(k - s, 0)
+    lo, hi = pad_along // 2, pad_along - pad_along // 2
+    padded = np.concatenate([np.zeros(lo), row, np.zeros(hi)])
+    out_len = -(-(len(padded) - k) // s) + 1
+    return np.array([padded[i * s: i * s + k].max() for i in range(out_len)])
+
+
+@pytest.mark.parametrize("length", [5, 6, 7, 8])
+def test_tf_same_maxpool_matches_reference_semantics(length):
+    rng = np.random.RandomState(0)
+    # odd lengths are where XLA 'SAME' and the reference's padding differ
+    x = rng.rand(1, 1, 1, length, 1).astype(np.float32)
+    out = _tf_same_max_pool(jnp.asarray(x), (1, 1, 3), (1, 1, 2))
+    expected = _naive_ref_maxpool_1d(x[0, 0, 0, :, 0], 3, 2)
+    np.testing.assert_allclose(np.asarray(out)[0, 0, 0, :, 0], expected)

@@ -1,0 +1,91 @@
+"""Numerical parity vs the reference PyTorch model (the only trustworthy
+full-model oracle — SURVEY.md §7 hard part 3).
+
+Builds the reference torch S3D with random weights, converts its state_dict
+through `torch_state_dict_to_flax`, and checks our Flax forward matches in
+eval mode.  Skipped when /root/reference or torch is unavailable.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REFERENCE = "/root/reference"
+
+torch = pytest.importorskip("torch")
+pytestmark = pytest.mark.skipif(not os.path.isdir(REFERENCE),
+                                reason="reference checkout not available")
+
+
+@pytest.fixture(scope="module")
+def torch_model(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("ref_assets")
+    vocab = np.array([f"word{i}" for i in range(50)])
+    np.save(tmp / "dict.npy", vocab)
+    torch.manual_seed(0)
+    w2v = torch.randn(51, 300)
+    torch.save(w2v, tmp / "word2vec.pth")
+    sys.path.insert(0, REFERENCE)
+    try:
+        import s3dg as ref_s3dg  # noqa
+    finally:
+        sys.path.remove(REFERENCE)
+    model = ref_s3dg.S3D(
+        num_classes=64,
+        word2vec_path=str(tmp / "word2vec.pth"),
+        token_to_word_path=str(tmp / "dict.npy"),
+    )
+    model.eval()
+    return model
+
+
+def _flax_model():
+    from milnce_tpu.models import S3D
+
+    return S3D(num_classes=64, vocab_size=51, word_embedding_dim=300,
+               text_hidden_dim=2048)
+
+
+def test_full_forward_parity(torch_model):
+    import jax.numpy as jnp
+
+    from milnce_tpu.utils.torch_convert import torch_state_dict_to_flax
+
+    sd = {k: v.detach().numpy() for k, v in torch_model.state_dict().items()}
+    variables = torch_state_dict_to_flax(sd)
+
+    rng = np.random.RandomState(1)
+    # odd post-conv1 spatial size (30 -> 15) exercises asymmetric TF-SAME pads
+    video = rng.rand(2, 3, 6, 30, 30).astype(np.float32)
+    text = rng.randint(0, 51, size=(2, 7)).astype(np.int64)
+
+    with torch.no_grad():
+        tv, tt = torch_model(torch.from_numpy(video), torch.from_numpy(text))
+
+    model = _flax_model()
+    jv, jt = model.apply(variables, jnp.asarray(video.transpose(0, 2, 3, 4, 1)),
+                         jnp.asarray(text.astype(np.int32)))
+
+    np.testing.assert_allclose(np.asarray(jt), tt.numpy(), atol=2e-4, rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(jv), tv.numpy(), atol=2e-4, rtol=1e-3)
+
+
+def test_mixed5c_parity(torch_model):
+    import jax.numpy as jnp
+
+    from milnce_tpu.utils.torch_convert import torch_state_dict_to_flax
+
+    sd = {k: v.detach().numpy() for k, v in torch_model.state_dict().items()}
+    variables = torch_state_dict_to_flax(sd)
+    rng = np.random.RandomState(2)
+    video = rng.rand(1, 3, 4, 32, 32).astype(np.float32)
+    with torch.no_grad():
+        tfeat = torch_model(torch.from_numpy(video), None, mode="video",
+                            mixed5c=True)
+    model = _flax_model()
+    jfeat = model.apply(variables, jnp.asarray(video.transpose(0, 2, 3, 4, 1)),
+                        None, mode="video", mixed5c=True)
+    np.testing.assert_allclose(np.asarray(jfeat), tfeat.numpy(), atol=2e-4,
+                               rtol=1e-3)

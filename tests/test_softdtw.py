@@ -1,0 +1,142 @@
+"""Soft-DTW: the lax.scan DP vs an independent numpy triple-loop golden,
+gradients vs the analytic E-matrix recursion, distance-function goldens.
+
+(This replicates — hermetically — the reference's only correctness check,
+the CPU<->GPU allclose cross-check at soft_dtw_cuda.py:439-440.)
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from milnce_tpu.ops.softdtw import (SoftDTW, cosine_cost, euclidean_cost,
+                                    negative_dot_cost, skew_cost, softdtw_scan)
+
+
+def numpy_softdtw(D, gamma, bandwidth=0):
+    """Triple-loop DP golden (independent transcription of the Cuturi-
+    Blondel recurrence, cf. soft_dtw_cuda.py:185-207)."""
+    B, N, M = D.shape
+    R = np.full((B, N + 2, M + 2), np.inf)
+    R[:, 0, 0] = 0.0
+    for b in range(B):
+        for j in range(1, M + 1):
+            for i in range(1, N + 1):
+                if 0 < bandwidth < abs(i - j):
+                    continue
+                r = np.array([-R[b, i - 1, j - 1], -R[b, i - 1, j],
+                              -R[b, i, j - 1]]) / gamma
+                rmax = r.max()
+                softmin = -gamma * (np.log(np.exp(r - rmax).sum()) + rmax)
+                R[b, i, j] = D[b, i - 1, j - 1] + softmin
+    return R[:, N, M], R
+
+
+def numpy_softdtw_grad(D, R, gamma):
+    """Analytic backward (E-matrix recursion, cf. soft_dtw_cuda.py:211-240)."""
+    B, N, M = D.shape
+    D_ = np.zeros((B, N + 2, M + 2))
+    E = np.zeros((B, N + 2, M + 2))
+    D_[:, 1:N + 1, 1:M + 1] = D
+    E[:, -1, -1] = 1.0
+    R = R.copy()
+    R[:, :, -1] = -np.inf
+    R[:, -1, :] = -np.inf
+    R[:, -1, -1] = R[:, -2, -2]
+    for b in range(B):
+        for j in range(M, 0, -1):
+            for i in range(N, 0, -1):
+                if np.isinf(R[b, i, j]):
+                    R[b, i, j] = -np.inf
+                a = np.exp((R[b, i + 1, j] - R[b, i, j] - D_[b, i + 1, j]) / gamma)
+                bb = np.exp((R[b, i, j + 1] - R[b, i, j] - D_[b, i, j + 1]) / gamma)
+                c = np.exp((R[b, i + 1, j + 1] - R[b, i, j] - D_[b, i + 1, j + 1]) / gamma)
+                E[b, i, j] = E[b, i + 1, j] * a + E[b, i, j + 1] * bb + E[b, i + 1, j + 1] * c
+    return E[:, 1:N + 1, 1:M + 1]
+
+
+def test_skew_cost_layout():
+    D = jnp.arange(6, dtype=jnp.float32).reshape(1, 2, 3)
+    s = np.asarray(skew_cost(D))
+    # out[p, i] = D[i, p - i]
+    assert s.shape == (1, 4, 2)
+    np.testing.assert_allclose(s[0, 0], [0, 0])        # D[0,0], pad
+    np.testing.assert_allclose(s[0, 1], [1, 3])        # D[0,1], D[1,0]
+    np.testing.assert_allclose(s[0, 2], [2, 4])
+    np.testing.assert_allclose(s[0, 3], [0, 5])
+
+
+@pytest.mark.parametrize("n,m,gamma", [(5, 5, 1.0), (7, 4, 0.1), (3, 9, 0.5)])
+def test_forward_matches_numpy(n, m, gamma):
+    rng = np.random.RandomState(0)
+    D = rng.rand(3, n, m).astype(np.float32)
+    expected, _ = numpy_softdtw(D.astype(np.float64), gamma)
+    got = np.asarray(softdtw_scan(jnp.asarray(D), gamma))
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_forward_with_bandwidth():
+    rng = np.random.RandomState(1)
+    D = rng.rand(2, 8, 8).astype(np.float32)
+    expected, _ = numpy_softdtw(D.astype(np.float64), 0.5, bandwidth=2)
+    got = np.asarray(softdtw_scan(jnp.asarray(D), 0.5, bandwidth=2))
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_gradient_matches_analytic_e_matrix():
+    rng = np.random.RandomState(2)
+    gamma = 0.8
+    D = rng.rand(2, 6, 5).astype(np.float32)
+    _, R = numpy_softdtw(D.astype(np.float64), gamma)
+    expected = numpy_softdtw_grad(D.astype(np.float64), R, gamma)
+    grad = jax.grad(lambda d: softdtw_scan(d, gamma).sum())(jnp.asarray(D))
+    np.testing.assert_allclose(np.asarray(grad), expected, rtol=1e-3, atol=1e-4)
+
+
+def test_gradient_is_nan_free_for_long_sequences():
+    rng = np.random.RandomState(3)
+    D = rng.rand(1, 64, 64).astype(np.float32)
+    grad = jax.grad(lambda d: softdtw_scan(d, 0.1).sum())(jnp.asarray(D))
+    assert np.isfinite(np.asarray(grad)).all()
+
+
+def test_distance_functions_match_naive():
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    y = rng.randn(2, 5, 4).astype(np.float32)
+    # naive loops
+    def naive(fn):
+        out = np.zeros((2, 3, 5), np.float32)
+        for b in range(2):
+            for i in range(3):
+                for j in range(5):
+                    out[b, i, j] = fn(x[b, i], y[b, j])
+        return out
+
+    np.testing.assert_allclose(
+        np.asarray(euclidean_cost(jnp.asarray(x), jnp.asarray(y))),
+        naive(lambda a, b: np.exp(np.linalg.norm(a - b))), rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(cosine_cost(jnp.asarray(x), jnp.asarray(y))),
+        naive(lambda a, b: np.exp(1 - a @ b / max(np.linalg.norm(a) * np.linalg.norm(b), 1e-8))),
+        rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(negative_dot_cost(jnp.asarray(x), jnp.asarray(y))),
+        naive(lambda a, b: -(a @ b)), rtol=1e-4, atol=1e-5)
+
+
+def test_softdtw_module_normalize_self_is_zero():
+    """normalize=True: sdtw(x, x) must be ~0 (soft_dtw_cuda.py:376-383)."""
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 6, 8).astype(np.float32)
+    sdtw = SoftDTW(gamma=1.0, normalize=True, dist_func="euclidean")
+    out = np.asarray(sdtw(jnp.asarray(x), jnp.asarray(x)))
+    np.testing.assert_allclose(out, 0.0, atol=1e-3)
+
+
+def test_no_length_cap():
+    """Sequences beyond the reference's 1024 CUDA cap still run."""
+    D = jnp.ones((1, 1100, 8), jnp.float32)
+    out = softdtw_scan(D, 1.0)
+    assert np.isfinite(float(out[0]))

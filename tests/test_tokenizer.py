@@ -1,0 +1,46 @@
+"""Tokenizer parity tests (behavior spec: reference s3dg.py:164-194,
+video_loader.py:97-117)."""
+
+import numpy as np
+
+from milnce_tpu.data.tokenizer import Tokenizer, synthetic_vocab
+
+
+def test_basic_encoding():
+    tok = Tokenizer(["hello", "world", "don't"], max_words=5)
+    out = tok.encode("hello world")
+    assert out.tolist() == [1, 2, 0, 0, 0]  # ids are index+1; 0 pads
+
+
+def test_regex_split_keeps_apostrophes():
+    # reference splits on [\w']+ (s3dg.py:180-182)
+    assert Tokenizer.split("don't stop, now!") == ["don't", "stop", "now"]
+
+
+def test_unknown_words_dropped_not_unked():
+    tok = Tokenizer(["alpha"], max_words=4)
+    out = tok.encode("alpha zebra alpha")
+    assert out.tolist() == [1, 1, 0, 0]
+
+
+def test_all_oov_gives_all_pad():
+    tok = Tokenizer(["alpha"], max_words=3)
+    assert tok.encode("zebra yak").tolist() == [0, 0, 0]  # s3dg.py:189-190
+
+
+def test_truncation():
+    tok = Tokenizer([f"w{i}" for i in range(10)], max_words=3)
+    out = tok.encode(" ".join(f"w{i}" for i in range(10)))
+    assert out.tolist() == [1, 2, 3]
+
+
+def test_batch_shape_and_dtype():
+    tok = Tokenizer(synthetic_vocab(16), max_words=6)
+    out = tok.encode_batch(["word1 word2", "word3"])
+    assert out.shape == (2, 6) and out.dtype == np.int32
+
+
+def test_non_string_input_stringified():
+    # reference tokenizes str(sentence) (video_loader.py:98)
+    tok = Tokenizer(["3"], max_words=2)
+    assert tok.encode(3).tolist() == [1, 0]
