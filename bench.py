@@ -1,0 +1,98 @@
+"""Throughput benchmark: clips/sec/chip of the full jitted train step
+(S3D-G fwd+bwd + MIL-NCE + Adam) on synthetic data.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no throughput numbers (BASELINE.md: "to be
+established"), so vs_baseline is measured against a fixed reference
+point recorded on first TPU runs (see BASELINE_THROUGHPUT below) —
+1.0 until a history exists.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+# clips/sec/chip anchor for vs_baseline; updated as rounds establish history.
+BASELINE_THROUGHPUT = None  # none published (BASELINE.md)
+
+
+def main():
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(os.path.dirname(
+                              os.path.abspath(__file__)), "build", "jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+    on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
+
+    import jax.numpy as jnp
+
+    from milnce_tpu.config import full_preset
+    from milnce_tpu.models.build import build_model
+    from milnce_tpu.parallel.mesh import build_mesh
+    from milnce_tpu.train.schedule import build_schedule
+    from milnce_tpu.train.state import build_optimizer, create_train_state
+    from milnce_tpu.train.step import make_train_step
+    from milnce_tpu.data.pipeline import device_prefetch
+
+    cfg = full_preset()
+    # Bench config: 16-frame 224^2 clips (the reference's published GPU
+    # configs, README.md:114-129), batch sized for one chip.
+    frames, size, words, k = 16, 224, 20, 5
+    batch = 16 if on_tpu else 2
+    if not on_tpu:
+        frames, size = 4, 64
+
+    cfg.model.vocab_size = 66250
+    model = build_model(cfg.model)
+    mesh = build_mesh(cfg.parallel)
+
+    rng = np.random.RandomState(0)
+    video = rng.randint(0, 255, (batch, frames, size, size, 3), np.uint8)
+    text = rng.randint(0, 66250, (batch * k, words)).astype(np.int32)
+
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, frames, size, size, 3), jnp.float32),
+                           jnp.zeros((2 * k, words), jnp.int32))
+    optimizer = build_optimizer(cfg.optim, build_schedule(cfg.optim, 1000))
+    state = create_train_state(variables, optimizer)
+    step_fn = make_train_step(model, optimizer, mesh)
+
+    video_d = jax.device_put(video)
+    text_d = jax.device_put(text)
+    start_d = jax.device_put(np.zeros((batch,), np.float32))
+
+    # warmup / compile
+    state, loss = step_fn(state, video_d, text_d, start_d)
+    jax.block_until_ready(loss)
+
+    n_steps = 10 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, loss = step_fn(state, video_d, text_d, start_d)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    n_chips = len(jax.devices())
+    clips_per_sec_per_chip = batch * n_steps / dt / n_chips
+    result = {
+        "metric": f"train_step clips/sec/chip ({frames}f@{size})",
+        "value": round(clips_per_sec_per_chip, 3),
+        "unit": "clips/sec/chip",
+        "vs_baseline": (round(clips_per_sec_per_chip / BASELINE_THROUGHPUT, 3)
+                        if BASELINE_THROUGHPUT else 1.0),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,7 @@ class DataConfig:
     video_root: str = ""
     caption_root: str = ""
     eval_video_root: str = ""
+    eval_csv: str = "csv/hmdb51.csv"    # in-training eval manifest
     fps: int = 10
     num_frames: int = 32
     video_size: int = 224

@@ -1,0 +1,208 @@
+"""Dataset sources: HowTo100M training + YouCook2 / MSR-VTT / HMDB-51 eval.
+
+Re-designs of the four reference loaders (video_loader.py,
+youcook_loader.py, msrvtt_loader.py, hmdb_loader.py) as plain host-side
+sources with an injectable decoder (hermetic tests run on
+:class:`milnce_tpu.data.video.FakeDecoder`; production uses
+:class:`FFmpegDecoder`).
+
+Manifest schemas (identical to the reference csv/ files):
+- train:   column ``video_path`` (video_loader.py:155-157), one caption
+  JSON per video id under ``caption_root``;
+- youcook: end,start,task,text,video_id (3,350 rows), videos resolved as
+  ``validation/<task>/<id>.{mp4,mkv,webm}`` (youcook_loader.py:124-131);
+- msrvtt:  key,vid_key,video_id,sentence (1,000 rows), windows over the
+  whole container duration (msrvtt_loader.py:117-119);
+- hmdb:    video_id,label,split1..3 (6,766 rows; 1=train 2=test per
+  official split), label from the id minus the ``_test`` suffix
+  (hmdb_loader.py:91-95).
+
+The reference's hmdb flip branch computes the flipped copy and then
+returns the un-flipped tensor (hmdb_loader.py:81-83 — latent bug,
+SURVEY.md §2.4); here ``with_flip`` honestly returns both orientations.
+"""
+
+from __future__ import annotations
+
+import csv as csv_mod
+import os
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+from milnce_tpu.config import DataConfig, ModelConfig
+from milnce_tpu.data.captions import CaptionTrack, sample_caption
+from milnce_tpu.data.tokenizer import Tokenizer, synthetic_vocab
+from milnce_tpu.data.video import (ClipDecoder, FFmpegDecoder, eval_windows,
+                                   pad_or_trim, sample_clip)
+
+
+def read_csv(path: str) -> list[dict]:
+    with open(path, newline="") as f:
+        return list(csv_mod.DictReader(f))
+
+
+def build_tokenizer(model_cfg: ModelConfig, max_words: int) -> Tokenizer:
+    """Tokenizer from the configured dict.npy vocabulary
+    (``model.token_dict_path``), or a synthetic vocab for hermetic runs."""
+    if model_cfg.token_dict_path and os.path.exists(model_cfg.token_dict_path):
+        return Tokenizer.from_npy(model_cfg.token_dict_path, max_words)
+    return Tokenizer(synthetic_vocab(model_cfg.vocab_size - 1), max_words)
+
+
+class HowTo100MSource:
+    """Training source: one (video clip, MIL caption bag) per draw
+    (video_loader.py:154-160)."""
+
+    CAPTION_CACHE_SIZE = 4096   # bounded: 1.2M videos/epoch would otherwise
+                                # accumulate every parsed caption JSON in RAM
+
+    def __init__(self, cfg: DataConfig, model_cfg: ModelConfig,
+                 decoder: Optional[ClipDecoder] = None,
+                 tokenizer: Optional[Tokenizer] = None):
+        self.cfg = cfg
+        self.rows = read_csv(cfg.train_csv)
+        assert self.rows and "video_path" in self.rows[0], cfg.train_csv
+        self.decoder = decoder or FFmpegDecoder()
+        self.tokenizer = tokenizer or build_tokenizer(model_cfg, cfg.max_words)
+        self._caption_cache: "OrderedDict[str, CaptionTrack]" = OrderedDict()
+        self._cache_lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def _captions(self, video_id: str) -> CaptionTrack:
+        with self._cache_lock:
+            if video_id in self._caption_cache:
+                self._caption_cache.move_to_end(video_id)
+                return self._caption_cache[video_id]
+        path = os.path.join(self.cfg.caption_root, video_id + ".json")
+        track = CaptionTrack.from_json_file(path)
+        with self._cache_lock:
+            self._caption_cache[video_id] = track
+            while len(self._caption_cache) > self.CAPTION_CACHE_SIZE:
+                self._caption_cache.popitem(last=False)
+        return track
+
+    def sample(self, idx: int, rng: np.random.RandomState) -> dict:
+        c = self.cfg
+        video_file = self.rows[idx]["video_path"]
+        video_id = os.path.basename(video_file).split(".")[0]
+        track = self._captions(video_id)
+        tokens, start, end = sample_caption(
+            track, rng, self.tokenizer, c.num_candidates, c.max_words,
+            c.min_time)
+        video = sample_clip(self.decoder,
+                            os.path.join(c.video_root, video_file),
+                            start, end, c.num_frames, c.fps, c.video_size,
+                            rng, c.crop_only, c.center_crop, c.random_flip)
+        return {"video": video, "text": tokens,
+                "start": np.float32(start)}   # CIDM loss input (loss.py:56)
+
+
+class YouCookSource:
+    """Zero-shot retrieval eval: per row, ``num_clip`` windows over the GT
+    segment + the tokenized caption (youcook_loader.py:14-134)."""
+
+    VIDEO_EXTS = (".mp4", ".mkv", ".webm")
+
+    def __init__(self, csv_path: str, video_root: str, cfg: DataConfig,
+                 tokenizer: Tokenizer, num_clip: int = 4,
+                 decoder: Optional[ClipDecoder] = None,
+                 max_words: int = 30):
+        self.rows = read_csv(csv_path)
+        self.video_root = video_root
+        self.cfg = cfg
+        self.tokenizer = tokenizer
+        self.num_clip = num_clip
+        self.decoder = decoder or FFmpegDecoder()
+        self.max_words = max_words
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def _resolve_video(self, row: dict) -> str:
+        base = os.path.join(self.video_root, "validation", row["task"],
+                            row["video_id"])
+        for ext in self.VIDEO_EXTS:
+            if os.path.exists(base + ext):
+                return base + ext
+        return base + self.VIDEO_EXTS[0]
+
+    def sample(self, idx: int, rng=None) -> dict:
+        row = self.rows[idx]
+        c = self.cfg
+        video = eval_windows(self.decoder, self._resolve_video(row),
+                             float(row["start"]), float(row["end"]),
+                             self.num_clip, c.num_frames, c.fps, c.video_size)
+        tokens = self.tokenizer.encode(row["text"], self.max_words)
+        return {"video": video, "text": tokens[None]}
+
+
+class MSRVTTSource:
+    """Zero-shot retrieval eval over full-video windows
+    (msrvtt_loader.py:13-128); duration comes from the container probe."""
+
+    def __init__(self, csv_path: str, video_root: str, cfg: DataConfig,
+                 tokenizer: Tokenizer, num_clip: int = 4,
+                 decoder: Optional[ClipDecoder] = None, max_words: int = 30):
+        self.rows = read_csv(csv_path)
+        self.video_root = video_root
+        self.cfg = cfg
+        self.tokenizer = tokenizer
+        self.num_clip = num_clip
+        self.decoder = decoder or FFmpegDecoder()
+        self.max_words = max_words
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def sample(self, idx: int, rng=None) -> dict:
+        row = self.rows[idx]
+        c = self.cfg
+        path = os.path.join(self.video_root, row["video_id"] + ".mp4")
+        duration = self.decoder.duration(path)
+        video = eval_windows(self.decoder, path, 0.0, duration, self.num_clip,
+                             c.num_frames, c.fps, c.video_size)
+        tokens = self.tokenizer.encode(row["sentence"], self.max_words)
+        return {"video": video, "text": tokens[None]}
+
+
+class HMDBSource:
+    """Linear-probe eval: windows over the whole video + class label +
+    the three official split assignments (hmdb_loader.py:14-95)."""
+
+    def __init__(self, csv_path: str, video_root: str, cfg: DataConfig,
+                 num_clip: int = 10, decoder: Optional[ClipDecoder] = None,
+                 with_flip: bool = False):
+        self.rows = read_csv(csv_path)
+        self.video_root = video_root
+        self.cfg = cfg
+        self.num_clip = num_clip
+        self.decoder = decoder or FFmpegDecoder()
+        self.with_flip = with_flip
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    @staticmethod
+    def label_of(label: str) -> str:
+        # the csv label column carries a '_test' suffix (hmdb_loader.py:91-95)
+        return label.rsplit("_test", 1)[0] if label.endswith("_test") else label
+
+    def sample(self, idx: int, rng=None) -> dict:
+        row = self.rows[idx]
+        c = self.cfg
+        # video_id already carries its extension (csv/hmdb51.csv)
+        path = os.path.join(self.video_root, row["video_id"])
+        duration = self.decoder.duration(path)
+        video = eval_windows(self.decoder, path, 0.0, duration, self.num_clip,
+                             c.num_frames, c.fps, c.video_size)
+        if self.with_flip:
+            video = np.concatenate([video, video[:, :, :, ::-1, :]], axis=0)
+        return {"video": video,
+                "label": self.label_of(row["label"]),
+                "splits": np.array([int(row["split1"]), int(row["split2"]),
+                                    int(row["split3"])], np.int32)}

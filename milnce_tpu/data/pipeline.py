@@ -1,0 +1,95 @@
+"""Host input pipeline: per-host sharded, threaded, device-prefetched.
+
+Replaces torch ``DataLoader + DistributedSampler`` (main_distributed.py:
+127-141) with a TPU-VM-shaped design:
+
+- the global sample index space is shuffled per epoch with a seed
+  (``DistributedSampler.set_epoch`` parity, main_distributed.py:187) and
+  partitioned by host process, then each host draws only its shard;
+- a thread pool of ``num_reader_threads`` decodes samples concurrently
+  (the decode cost is ffmpeg-subprocess-bound, so Python threads scale —
+  same reasoning as torch's worker processes but without pickling);
+- batches stay **uint8** end-to-end and are handed to
+  :func:`device_prefetch`, which keeps ``depth`` batches in flight on
+  device (async ``device_put``) so host decode overlaps device compute;
+- ``drop_last=True`` semantics: only full GLOBAL batches are emitted
+  (a short epoch tail never stalls a pod step — SURVEY.md §7 hard part 2).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import itertools
+from typing import Callable, Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class ShardedLoader:
+    """Iterates a source (len + sample(idx, rng)) as per-host batches."""
+
+    def __init__(self, source, global_batch_size: int, seed: int = 0,
+                 num_threads: int = 8, shuffle: bool = True,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None,
+                 drop_last: bool = True):
+        self.source = source
+        self.global_batch = int(global_batch_size)
+        self.seed = seed
+        self.num_threads = max(1, int(num_threads))
+        self.shuffle = shuffle
+        self.pi = jax.process_index() if process_index is None else process_index
+        self.pc = jax.process_count() if process_count is None else process_count
+        assert self.global_batch % self.pc == 0, (global_batch_size, self.pc)
+        self.local_batch = self.global_batch // self.pc
+        self.drop_last = drop_last
+
+    def steps_per_epoch(self) -> int:
+        n = len(self.source)
+        return n // self.global_batch if self.drop_last else -(-n // self.global_batch)
+
+    def epoch(self, epoch: int) -> Iterator[dict]:
+        """Yield this host's batches for one epoch (dicts of stacked np)."""
+        n = len(self.source)
+        order = np.arange(n)
+        if self.shuffle:
+            np.random.RandomState(self.seed + epoch).shuffle(order)
+        usable = (n // self.global_batch) * self.global_batch
+        order = order[:usable]
+        # host h takes rows h, h+pc, h+2pc... of each global batch
+        local = order.reshape(-1, self.global_batch)[:, self.pi::self.pc]
+
+        rng_base = self.seed * 100_003 + epoch
+        with cf.ThreadPoolExecutor(self.num_threads) as pool:
+            def fetch(idx):
+                return self.source.sample(
+                    int(idx), np.random.RandomState((rng_base + int(idx)) % (2**31)))
+
+            for batch_ids in local:
+                samples = list(pool.map(fetch, batch_ids))
+                yield {k: np.stack([s[k] for s in samples]) for k in samples[0]}
+
+
+def device_prefetch(iterator: Iterator[dict], mesh: Mesh,
+                    axis: str = "data", depth: int = 2) -> Iterator[dict]:
+    """Keep ``depth`` batches in flight on device, sharded on dim 0.
+    ``device_put`` is async, so this overlaps H2D transfer with compute."""
+    sharding = NamedSharding(mesh, P(axis))
+    put = lambda b: jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), b)
+    queue = []
+    for batch in iterator:
+        queue.append(put(batch))
+        if len(queue) > depth:
+            yield queue.pop(0)
+    yield from queue
+
+
+def flatten_text(batch: dict) -> tuple:
+    """{'video': (B,T,H,W,3) u8, 'text': (B,K,W) i32} ->
+    (video, text reshaped (B*K, W)) — the reference's flatten at
+    main_distributed.py:229."""
+    text = batch["text"]
+    return batch["video"], text.reshape(-1, text.shape[-1])

@@ -1,0 +1,39 @@
+"""Hermetic in-memory video-text source (no ffmpeg, no files).
+
+The reference has no hermetic path at all — its smallest config still
+needs real videos + caption JSONs (SURVEY.md §4).  This source emits
+deterministic pseudo-video (uint8) and token ids with the exact same
+batch contract as the real HowTo100M source, so the full train loop,
+sharding, checkpointing, and bench run anywhere.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from milnce_tpu.config import DataConfig
+
+
+class SyntheticVideoTextSource:
+    """len() + sample(idx, rng) -> {'video': (T,H,W,3) u8, 'text': (K,W) i32}."""
+
+    def __init__(self, cfg: DataConfig, vocab_size: int = 128,
+                 num_samples: int | None = None):
+        self.cfg = cfg
+        self.vocab_size = vocab_size
+        self.num_samples = num_samples or cfg.synthetic_num_samples
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def sample(self, idx: int, rng: np.random.RandomState) -> dict:
+        c = self.cfg
+        base = np.random.RandomState(idx % 1000)
+        video = base.randint(0, 255, size=(c.num_frames, c.video_size,
+                                           c.video_size, 3), dtype=np.uint8)
+        text = base.randint(1, self.vocab_size,
+                            size=(c.num_candidates, c.max_words)).astype(np.int32)
+        # zero-pad tail like real captions
+        text[:, c.max_words // 2:] = 0
+        return {"video": video, "text": text,
+                "start": np.float32(idx % 100)}

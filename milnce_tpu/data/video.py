@@ -1,0 +1,152 @@
+"""Host-side video decode via the ffmpeg binary.
+
+The reference drives ffmpeg through the `ffmpeg-python` graph builder
+(video_loader.py:58-88); we build the same filter graph as plain
+subprocess args — fewer moving parts on a TPU-VM host image.  The decode
+stays on the host CPU feeding the device pipeline (the BASELINE.json
+north star keeps ffmpeg on the host).
+
+Filter-graph parity with video_loader.py:60-88:
+- seek: ``-ss start -t num_sec+0.1`` on the INPUT side;
+- ``fps=<fps>`` filter;
+- crop: either direct ``size x size`` crop at a fractional offset
+  (crop_only, :69-74) or largest-square crop + bilinear scale (:75-82);
+- optional horizontal flip (:83-84);
+- rawvideo rgb24 on stdout -> numpy.
+
+Output is channels-LAST ``(T, H, W, 3) uint8`` (our model layout; the
+reference permutes to torch's (3,T,H,W) at video_loader.py:91), zero-
+padded/truncated to ``num_frames`` (:92-95).
+
+Everything is injectable for tests: :class:`FakeDecoder` yields
+deterministic frames with no ffmpeg present.
+"""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+from dataclasses import dataclass
+from typing import Optional, Protocol
+
+import numpy as np
+
+
+class ClipDecoder(Protocol):
+    def decode(self, path: str, start_seek: float, num_sec: float,
+               fps: int, size: int, aw: float, ah: float, crop_only: bool,
+               hflip: bool) -> np.ndarray: ...
+
+    def duration(self, path: str) -> float: ...
+
+
+def _crop_expr(size: int, aw: float, ah: float, crop_only: bool) -> str:
+    # ffmpeg crop filter is crop=w:h:x:y
+    if crop_only:
+        return f"crop={size}:{size}:(iw-{size})*{aw}:(ih-{size})*{ah}"
+    return (f"crop=min(iw\\,ih):min(iw\\,ih)"
+            f":(iw-min(iw\\,ih))*{aw}:(ih-min(iw\\,ih))*{ah}"
+            f",scale={size}:{size}")
+
+
+@dataclass
+class FFmpegDecoder:
+    binary: str = "ffmpeg"
+    probe_binary: str = "ffprobe"
+
+    def available(self) -> bool:
+        return shutil.which(self.binary) is not None
+
+    def decode(self, path: str, start_seek: float, num_sec: float,
+               fps: int, size: int, aw: float = 0.5, ah: float = 0.5,
+               crop_only: bool = False, hflip: bool = False) -> np.ndarray:
+        if not self.available():
+            raise RuntimeError(
+                "ffmpeg binary not found — install it on the host or use the "
+                "synthetic data source (data.synthetic=True)")
+        vf = f"fps={fps},{_crop_expr(size, aw, ah, crop_only)}"
+        if hflip:
+            vf += ",hflip"
+        cmd = [self.binary, "-nostdin", "-ss", f"{start_seek}",
+               "-t", f"{num_sec + 0.1}", "-i", path, "-vf", vf,
+               "-f", "rawvideo", "-pix_fmt", "rgb24", "pipe:"]
+        out = subprocess.run(cmd, stdout=subprocess.PIPE,
+                             stderr=subprocess.DEVNULL, check=True).stdout
+        n = len(out) // (size * size * 3)
+        return np.frombuffer(out[: n * size * size * 3],
+                             np.uint8).reshape(n, size, size, 3)
+
+    def duration(self, path: str) -> float:
+        """Container duration in seconds (the reference uses
+        ``ffmpeg.probe``, msrvtt_loader.py:117-119)."""
+        cmd = [self.probe_binary, "-v", "error", "-show_entries",
+               "format=duration", "-of",
+               "default=noprint_wrappers=1:nokey=1", path]
+        return float(subprocess.run(cmd, stdout=subprocess.PIPE,
+                                    check=True).stdout.strip())
+
+
+@dataclass
+class FakeDecoder:
+    """Deterministic pseudo-decoder for hermetic tests: frame values are a
+    function of (path hash, frame index)."""
+
+    frames_per_clip: int = 64
+    fixed_duration: float = 30.0
+
+    def decode(self, path: str, start_seek: float, num_sec: float,
+               fps: int, size: int, aw: float = 0.5, ah: float = 0.5,
+               crop_only: bool = False, hflip: bool = False) -> np.ndarray:
+        n = min(self.frames_per_clip, max(1, int(round(num_sec * fps))))
+        seed = (hash(path) ^ int(start_seek * 7 + fps)) % (2 ** 31)
+        rng = np.random.RandomState(seed)
+        frames = rng.randint(0, 255, size=(n, size, size, 3), dtype=np.uint8)
+        if hflip:
+            frames = frames[:, :, ::-1, :]
+        return frames
+
+    def duration(self, path: str) -> float:
+        return self.fixed_duration
+
+
+def pad_or_trim(frames: np.ndarray, num_frames: int) -> np.ndarray:
+    """Zero-pad the tail / truncate to exactly ``num_frames``
+    (video_loader.py:92-95)."""
+    t = frames.shape[0]
+    if t >= num_frames:
+        return frames[:num_frames]
+    pad = np.zeros((num_frames - t,) + frames.shape[1:], frames.dtype)
+    return np.concatenate([frames, pad], axis=0)
+
+
+def sample_clip(decoder: ClipDecoder, path: str, start: float, end: float,
+                num_frames: int, fps: int, size: int,
+                rng: np.random.RandomState, crop_only: bool,
+                center_crop: bool, random_flip: bool) -> np.ndarray:
+    """Random training clip draw within [start, end]
+    (video_loader.py:58-95): random seek, random or center fractional
+    crop offset, coin-flip hflip."""
+    num_sec = num_frames / float(fps)
+    hi = int(max(start, end - num_sec))
+    start_seek = rng.randint(int(start), hi + 1)
+    if center_crop:
+        aw = ah = 0.5
+    else:
+        aw, ah = rng.uniform(0, 1), rng.uniform(0, 1)
+    hflip = bool(random_flip and rng.uniform(0, 1) > 0.5)
+    frames = decoder.decode(path, start_seek, num_sec, fps, size, aw, ah,
+                            crop_only, hflip)
+    return pad_or_trim(frames, num_frames)
+
+
+def eval_windows(decoder: ClipDecoder, path: str, start: float, end: float,
+                 num_clip: int, num_frames: int, fps: int,
+                 size: int) -> np.ndarray:
+    """``num_clip`` deterministic center-cropped windows linspaced over
+    [start, end] (youcook_loader.py:52-57) -> (num_clip, T, H, W, 3) u8."""
+    num_sec = num_frames / float(fps)
+    starts = np.linspace(start, max(start, end - num_sec), num_clip)
+    clips = [pad_or_trim(decoder.decode(path, float(s), num_sec, fps, size,
+                                        0.5, 0.5, False, False), num_frames)
+             for s in starts]
+    return np.stack(clips, axis=0)

@@ -1,0 +1,124 @@
+"""Evaluation entry points:
+
+    python -m milnce_tpu.eval.cli youcook --ckpt <dir|.pth> --csv ... --video_root ...
+    python -m milnce_tpu.eval.cli msrvtt  ...
+    python -m milnce_tpu.eval.cli hmdb    ...
+
+One CLI replaces the three reference scripts (eval_youcook.py,
+eval_msrvtt.py, eval_hmdb.py), including their dual checkpoint-format
+sniffing (eval_msrvtt.py:21-32): a directory is treated as an Orbax run
+checkpoint; a ``.pth``/``.pth.tar`` file as a torch checkpoint converted
+through ``milnce_tpu.utils.torch_convert`` (both the DDP 'state_dict'
+wrapper and the upstream flat S3D_HowTo100M format, the latter implying
+``space_to_depth=True``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import jax
+import numpy as np
+
+from milnce_tpu.config import DataConfig, ModelConfig
+from milnce_tpu.data.datasets import (HMDBSource, MSRVTTSource, YouCookSource,
+                                      build_tokenizer)
+from milnce_tpu.eval.linear_probe import evaluate_linear_probe
+from milnce_tpu.eval.metrics import format_metrics
+from milnce_tpu.eval.retrieval import evaluate_retrieval
+from milnce_tpu.models.build import build_model
+from milnce_tpu.parallel.mesh import build_mesh
+from milnce_tpu.config import ParallelConfig
+
+
+def load_variables(ckpt: str, model, model_cfg: ModelConfig,
+                   sample_shapes) -> dict:
+    if not os.path.exists(ckpt):
+        raise FileNotFoundError(
+            f"checkpoint not found: {ckpt!r} (expected an Orbax run "
+            "directory or a torch .pth/.pth.tar file)")
+    if os.path.isdir(ckpt):
+        import orbax.checkpoint as ocp
+
+        from milnce_tpu.train.checkpoint import CheckpointManager
+        from milnce_tpu.train.schedule import cosine_with_warmup
+        from milnce_tpu.train.state import build_optimizer, create_train_state
+        from milnce_tpu.config import OptimConfig
+
+        video, text = sample_shapes
+        variables = model.init(jax.random.PRNGKey(0), video, text)
+        optimizer = build_optimizer(OptimConfig(),
+                                    cosine_with_warmup(1e-3, 1, 2))
+        template = create_train_state(variables, optimizer)
+        mgr = CheckpointManager(ckpt)
+        epoch, state = mgr.restore_latest(template)
+        print(f"loaded Orbax checkpoint (epoch {epoch}) from {ckpt}")
+        return {"params": state.params, "batch_stats": state.batch_stats}
+    # torch formats
+    import torch
+
+    from milnce_tpu.utils.torch_convert import torch_state_dict_to_flax
+
+    raw = torch.load(ckpt, map_location="cpu", weights_only=False)
+    if "state_dict" in raw:
+        sd = raw["state_dict"]
+    else:
+        sd = raw
+    sd = {k: v.numpy() for k, v in sd.items() if hasattr(v, "numpy")}
+    print(f"loaded torch checkpoint with {len(sd)} tensors from {ckpt}")
+    return torch_state_dict_to_flax(sd)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="milnce-tpu eval")
+    p.add_argument("task", choices=["youcook", "msrvtt", "hmdb"])
+    p.add_argument("--ckpt", required=True)
+    p.add_argument("--csv", required=True)
+    p.add_argument("--video_root", required=True)
+    p.add_argument("--token_dict", default="")
+    p.add_argument("--word2vec", default="")
+    p.add_argument("--num_windows", type=int, default=4)
+    p.add_argument("--batch_size", type=int, default=16)
+    p.add_argument("--num_frames", type=int, default=16)
+    p.add_argument("--video_size", type=int, default=224)
+    p.add_argument("--fps", type=int, default=10)
+    p.add_argument("--space_to_depth", action="store_true",
+                   help="upstream flat checkpoints need this")
+    p.add_argument("--max_words", type=int, default=30)
+    args = p.parse_args(argv)
+
+    data_cfg = DataConfig(fps=args.fps, num_frames=args.num_frames,
+                          video_size=args.video_size, max_words=args.max_words)
+    model_cfg = ModelConfig(space_to_depth=args.space_to_depth,
+                            token_dict_path=args.token_dict,
+                            word2vec_path=args.word2vec)
+    model = build_model(model_cfg)
+    mesh = build_mesh(ParallelConfig())
+
+    import jax.numpy as jnp
+    sample = (jnp.zeros((1, args.num_frames, args.video_size,
+                         args.video_size, 3), jnp.float32),
+              jnp.zeros((1, args.max_words), jnp.int32))
+    variables = load_variables(args.ckpt, model, model_cfg, sample)
+
+    if args.task == "hmdb":
+        source = HMDBSource(args.csv, args.video_root, data_cfg,
+                            num_clip=args.num_windows)
+        accs = evaluate_linear_probe(model, variables, source, mesh)
+        for k, v in accs.items():
+            print(f"HMDB top-1 {k}: {v:.4f}")
+        return accs
+
+    tokenizer = build_tokenizer(model_cfg, args.max_words)
+    cls = YouCookSource if args.task == "youcook" else MSRVTTSource
+    source = cls(args.csv, args.video_root, data_cfg, tokenizer,
+                 num_clip=args.num_windows, max_words=args.max_words)
+    metrics = evaluate_retrieval(model, variables, source, mesh,
+                                 batch_size=args.batch_size)
+    print(format_metrics(metrics))
+    return metrics
+
+
+if __name__ == "__main__":
+    main()

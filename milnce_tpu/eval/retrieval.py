@@ -1,0 +1,68 @@
+"""Zero-shot text->video retrieval evaluation.
+
+Shape of the reference eval scripts (eval_msrvtt.py:57-76,
+eval_youcook.py identical): batched no-grad forward of both towers,
+mean-pool the ``num_windows_test`` clip embeddings per video (window
+ensembling, eval_msrvtt.py:68-69), then the full T x V dot-product
+matrix -> R@k / MedR.
+
+The forward runs as a jitted shard_map over the mesh (uint8 in, /255 on
+device); embedding accumulation happens on host exactly like the
+reference (:70-72).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from milnce_tpu.eval.metrics import compute_retrieval_metrics
+from milnce_tpu.train.step import make_text_embed_fn, make_video_embed_fn
+
+
+def extract_retrieval_embeddings(model, variables, source, mesh: Mesh,
+                                 batch_size: int = 16,
+                                 data_axis: str = "data"):
+    """Iterate an eval source ({'video': (C,T,H,W,3) u8, 'text': (1,W)}),
+    return (text_embds (N,D), video_embds (N,D)) with window-mean pooling."""
+    video_fn = make_video_embed_fn(model, mesh, data_axis)
+    text_fn = make_text_embed_fn(model, mesh, data_axis)
+    n_dev = int(np.prod(list(mesh.shape.values())))
+    batch_size = max(n_dev, (batch_size // n_dev) * n_dev)
+
+    v_out, t_out = [], []
+    buf_v, buf_t = [], []
+
+    def flush():
+        if not buf_v:
+            return
+        pad = (-len(buf_v)) % n_dev            # pad to divisibility, drop after
+        videos = np.stack(buf_v + [buf_v[-1]] * pad)     # (B, C, T, H, W, 3)
+        texts = np.stack(buf_t + [buf_t[-1]] * pad)      # (B, 1, W)
+        b, c = videos.shape[:2]
+        clip_embd = video_fn(variables, videos.reshape((-1,) + videos.shape[2:]))
+        clip_embd = np.asarray(clip_embd).reshape(b, c, -1)
+        v_out.append(clip_embd.mean(axis=1)[:b - pad if pad else b])
+        t_embd = np.asarray(text_fn(variables, texts.reshape(-1, texts.shape[-1])))
+        t_out.append(t_embd.reshape(b, -1)[:b - pad if pad else b])
+        buf_v.clear()
+        buf_t.clear()
+
+    for i in range(len(source)):
+        s = source.sample(i)
+        buf_v.append(s["video"])
+        buf_t.append(s["text"])
+        if len(buf_v) == batch_size:
+            flush()
+    flush()
+    return np.concatenate(t_out), np.concatenate(v_out)
+
+
+def evaluate_retrieval(model, variables, source, mesh: Mesh,
+                       batch_size: int = 16) -> dict:
+    t, v = extract_retrieval_embeddings(model, variables, source, mesh,
+                                        batch_size)
+    return compute_retrieval_metrics(t @ v.T)

@@ -1,0 +1,3 @@
+from milnce_tpu.losses.milnce import milnce_loss  # noqa: F401
+from milnce_tpu.losses.dtw_losses import (  # noqa: F401
+    cdtw_loss, sdtw_3_loss, sdtw_cidm_loss, sdtw_negative_loss)

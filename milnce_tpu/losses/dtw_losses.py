@@ -17,6 +17,18 @@ import jax.numpy as jnp
 from milnce_tpu.ops.softdtw import SoftDTW, _cosine_sim
 
 
+def cdtw_batch_loss(video_seq: jax.Array, text_seq: jax.Array,
+                    gamma: float = 1e-5, backend: str = "scan") -> jax.Array:
+    """Batch-mean contrastive DTW: the reference's CDTW (loss.py:20-32)
+    scores only the ``args.rank``-th anchor per step; averaging over every
+    anchor is the batch-generic equivalent (identical in expectation)."""
+    sdtw = SoftDTW(gamma=gamma, dist_func="cosine", backend=backend)
+    pairs = _all_pairs_sdtw(video_seq, text_seq, sdtw)     # (B, B)
+    pos = jnp.diagonal(pairs)
+    neg = jax.nn.logsumexp(pairs, axis=1)
+    return jnp.mean(pos - neg)
+
+
 def cdtw_loss(video_seq: jax.Array, text_seq: jax.Array, index: jax.Array | int,
               gamma: float = 1e-5, backend: str = "scan") -> jax.Array:
     """Contrastive DTW for one anchor row (reference CDTW, loss.py:20-32):

@@ -18,7 +18,13 @@ from milnce_tpu.models.text import word2vec_embedding_init
 
 
 def load_word2vec_table(path: str) -> np.ndarray:
-    """Load a pretrained (V, 300) embedding table from .npy/.npz."""
+    """Load a pretrained (V, 300) embedding table from .npy/.npz, or from
+    the reference's torch-saved ``word2vec.pth`` (s3dg.py:159)."""
+    if path.endswith((".pth", ".pt", ".tar")):
+        import torch
+
+        return torch.load(path, map_location="cpu",
+                          weights_only=False).numpy()
     if path.endswith(".npz"):
         with np.load(path) as z:
             return z[list(z.files)[0]]

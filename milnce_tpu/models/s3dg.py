@@ -283,9 +283,9 @@ class S3D(nn.Module):
             name="text_module",
         )
 
-    def forward_video(self, video: Array, mixed5c: bool = False,
-                      train: bool = False) -> Array:
-        """Video stack, mirrors reference s3dg.py:265-328."""
+    def _trunk(self, video: Array, train: bool) -> Array:
+        """Conv trunk up to mixed_5c (B, T', H', W', 1024), mirrors
+        reference s3dg.py:265-321."""
         net = video
         if self.use_space_to_depth:
             net = space_to_depth(net)
@@ -309,10 +309,28 @@ class S3D(nn.Module):
         net = _tf_same_max_pool(net, (2, 2, 2), (2, 2, 2))   # maxpool_5a
         net = self.mixed_5b(net, train)
         net = self.mixed_5c(net, train)
-        net = jnp.mean(net, axis=(1, 2, 3))                  # global avg pool
+        return net
+
+    def forward_video(self, video: Array, mixed5c: bool = False,
+                      train: bool = False) -> Array:
+        """Pooled video embedding (reference s3dg.py:323-328)."""
+        net = jnp.mean(self._trunk(video, train), axis=(1, 2, 3))
         if mixed5c:
             return net                                       # (B, 1024)
         return self.fc(net)                                  # (B, num_classes)
+
+    def forward_video_sequence(self, video: Array,
+                               train: bool = False) -> Array:
+        """Temporal sequence of frame-group embeddings: pool mixed_5c over
+        space only -> (B, T', num_classes).
+
+        This is the sequence view the fork's (soft-)DTW losses align
+        (loss.py:20-134 operate on (B, n, d) sequences); the reference
+        never committed the model change that produces them — we make it a
+        first-class mode.
+        """
+        net = jnp.mean(self._trunk(video, train), axis=(2, 3))
+        return self.fc(net)
 
     def forward_text(self, tokens: Array) -> Array:
         return self.text_module(tokens)
@@ -326,4 +344,8 @@ class S3D(nn.Module):
             return self.forward_video(video, mixed5c=mixed5c, train=train)
         if mode == "text":
             return self.forward_text(text)
+        if mode == "sequence":
+            # (video seq (B, T', D), per-candidate text (B', D))
+            return (self.forward_video_sequence(video, train=train),
+                    self.forward_text(text))
         raise NotImplementedError(mode)

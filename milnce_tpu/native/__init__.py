@@ -1,0 +1,1 @@
+from milnce_tpu.native.build import load_native_library, native_available  # noqa: F401

@@ -1,0 +1,90 @@
+"""Build + load the native C++ runtime library (ctypes, no pybind11).
+
+Compiles ``native/milnce_native.cpp`` on first use into
+``build/libmilnce_native.so`` (cached by source mtime).  Everything that
+uses it degrades gracefully when no C++ toolchain is present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import subprocess
+import threading
+from typing import Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "milnce_native.cpp")
+_OUT = os.path.join(_REPO_ROOT, "build", "libmilnce_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _compile() -> bool:
+    cxx = shutil.which("g++") or shutil.which("c++")
+    if cxx is None or not os.path.exists(_SRC):
+        return False
+    os.makedirs(os.path.dirname(_OUT), exist_ok=True)
+    cmd = [cxx, "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
+           "-o", _OUT, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+        return True
+    except subprocess.CalledProcessError as e:
+        import sys
+
+        print(f"milnce_native build failed:\n{e.stderr.decode()}",
+              file=sys.stderr)
+        return False
+
+
+def load_native_library() -> Optional[ctypes.CDLL]:
+    """Compile-if-stale and dlopen the native library; None if unavailable."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        stale = (not os.path.exists(_OUT)
+                 or (os.path.exists(_SRC)
+                     and os.path.getmtime(_SRC) > os.path.getmtime(_OUT)))
+        if stale and not _compile():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_OUT)
+        except OSError:
+            _load_failed = True
+            return None
+        _declare(lib)
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return load_native_library() is not None
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lib.reader_create.restype = ctypes.c_void_p
+    lib.reader_create.argtypes = [ctypes.c_int]
+    lib.reader_submit.restype = ctypes.c_int
+    lib.reader_submit.argtypes = [ctypes.c_void_p, ctypes.c_char_p, u8p,
+                                  ctypes.c_long]
+    lib.reader_wait.restype = ctypes.c_long
+    lib.reader_wait.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.reader_destroy.restype = None
+    lib.reader_destroy.argtypes = [ctypes.c_void_p]
+    lib.softdtw_forward_cpu.restype = None
+    lib.softdtw_forward_cpu.argtypes = [f32p, f32p, f32p, ctypes.c_int,
+                                        ctypes.c_int, ctypes.c_int,
+                                        ctypes.c_float, ctypes.c_int]
+    lib.softdtw_backward_cpu.restype = None
+    lib.softdtw_backward_cpu.argtypes = [f32p, f32p, f32p, f32p, ctypes.c_int,
+                                         ctypes.c_int, ctypes.c_int,
+                                         ctypes.c_float, ctypes.c_int]

@@ -1,0 +1,57 @@
+"""C++ soft-DTW CPU kernels (ctypes front-end).
+
+Exact forward/backward DP threaded over the batch — the native
+counterpart of the reference's numba ``nopython`` kernels
+(soft_dtw_cuda.py:185-240).  Used as a host-side golden check and a fast
+eval fallback; wired into JAX via ``jax.custom_vjp`` + ``pure_callback``
+so it composes with ``grad`` (but not ``jit`` on TPU — it is a HOST
+kernel by design)."""
+
+from __future__ import annotations
+
+import ctypes
+from functools import partial
+
+import numpy as np
+
+from milnce_tpu.native.build import load_native_library
+
+
+def _f32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def softdtw_forward_native(D: np.ndarray, gamma: float,
+                           bandwidth: int = 0):
+    """D: (B, N, M) float32 -> (value (B,), R (B, N+2, M+2))."""
+    lib = load_native_library()
+    assert lib is not None, "native library unavailable"
+    D = np.ascontiguousarray(D, np.float32)
+    b, n, m = D.shape
+    R = np.empty((b, n + 2, m + 2), np.float32)
+    value = np.empty((b,), np.float32)
+    lib.softdtw_forward_cpu(_f32p(D), _f32p(R), _f32p(value), b, n, m,
+                            ctypes.c_float(gamma), int(bandwidth))
+    return value, R
+
+
+def softdtw_backward_native(D: np.ndarray, R: np.ndarray,
+                            grad_out: np.ndarray, gamma: float,
+                            bandwidth: int = 0) -> np.ndarray:
+    lib = load_native_library()
+    assert lib is not None, "native library unavailable"
+    D = np.ascontiguousarray(D, np.float32)
+    R = np.ascontiguousarray(R, np.float32)
+    grad_out = np.ascontiguousarray(grad_out, np.float32)
+    b, n, m = D.shape
+    E = np.empty((b, n, m), np.float32)
+    lib.softdtw_backward_cpu(_f32p(D), _f32p(R), _f32p(grad_out), _f32p(E),
+                             b, n, m, ctypes.c_float(gamma), int(bandwidth))
+    return E
+
+
+def softdtw_native(D: np.ndarray, gamma: float, bandwidth: int = 0):
+    """Differentiable-by-hand numpy API: returns (value, vjp_fn)."""
+    value, R = softdtw_forward_native(D, gamma, bandwidth)
+    return value, partial(softdtw_backward_native, D, R, gamma=gamma,
+                          bandwidth=bandwidth)

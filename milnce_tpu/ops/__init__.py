@@ -1,0 +1,3 @@
+from milnce_tpu.ops.softdtw import SoftDTW, softdtw_scan  # noqa: F401
+from milnce_tpu.ops.softdtw_pallas import softdtw_pallas  # noqa: F401
+from milnce_tpu.ops.dtw import dtw_loss  # noqa: F401

@@ -1,0 +1,50 @@
+"""Checkpoint save/rotate/resume on Orbax.
+
+Same semantics as the reference (main_distributed.py:192-200, 289-302):
+one checkpoint per epoch, sliding retention window (default 10), resume
+from the newest — but sharded/async via Orbax instead of rank-0
+``torch.save`` of a monolithic state dict, so multi-host saves scale and
+don't stall the step loop.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import orbax.checkpoint as ocp
+
+from milnce_tpu.train.state import TrainState
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, keep: int = 10):
+        directory = os.path.abspath(directory)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=keep, create=True, enable_async_checkpointing=True)
+        self._mgr = ocp.CheckpointManager(directory, options=options)
+
+    def save(self, epoch: int, state: TrainState) -> None:
+        self._mgr.save(epoch, args=ocp.args.StandardSave(state))
+
+    def latest_epoch(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, epoch: int, template: TrainState) -> TrainState:
+        return self._mgr.restore(epoch, args=ocp.args.StandardRestore(template))
+
+    def restore_latest(self, template: TrainState) -> Tuple[int, TrainState]:
+        """Returns (next_epoch, state); (0, template) when nothing saved —
+        mirrors get_last_checkpoint's empty-string fallback
+        (main_distributed.py:296-302)."""
+        latest = self.latest_epoch()
+        if latest is None:
+            return 0, template
+        return latest, self.restore(latest, template)
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()

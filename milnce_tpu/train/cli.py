@@ -1,0 +1,20 @@
+"""Training entry point: ``python -m milnce_tpu.train.cli --preset small``.
+
+Replaces all three reference launchers (main_distributed.py, train.py,
+train_small.py — the latter two being near-duplicate clones, one of them
+import-broken, SURVEY.md §2.4) with one CLI over the typed config."""
+
+from __future__ import annotations
+
+from milnce_tpu.config import parse_cli
+from milnce_tpu.train.loop import run_training
+
+
+def main(argv=None):
+    cfg = parse_cli(argv, description="milnce-tpu trainer")
+    result = run_training(cfg)
+    print(f"done: {result.steps} steps, final loss {result.last_loss:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,191 @@
+"""Epoch driver: the orchestration layer.
+
+Mirrors the reference's main_worker + train loop responsibilities
+(main_distributed.py:65-224) minus everything XLA/the mesh already does:
+no DDP wrapper, no per-GPU batch arithmetic, no CUDA device pinning.
+
+Logging format parity: every ``n_display`` steps emit epoch, elapsed
+time, epoch progress, windowed mean loss, and current LR
+(main_distributed.py:211-222), to stdout and a logfile under
+``log_root`` (:304-306).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import numpy as np
+
+from milnce_tpu.config import Config
+from milnce_tpu.data.pipeline import (ShardedLoader, device_prefetch,
+                                      flatten_text)
+from milnce_tpu.data.synthetic import SyntheticVideoTextSource
+from milnce_tpu.models.build import build_model
+from milnce_tpu.parallel.mesh import build_mesh, initialize_distributed
+from milnce_tpu.train.checkpoint import CheckpointManager
+from milnce_tpu.train.schedule import build_schedule
+from milnce_tpu.train.state import TrainState, build_optimizer, create_train_state
+from milnce_tpu.train.step import make_train_step
+from milnce_tpu.utils.logging import RunLogger
+
+
+def build_source(cfg: Config):
+    if cfg.data.synthetic:
+        return SyntheticVideoTextSource(cfg.data, vocab_size=cfg.model.vocab_size)
+    from milnce_tpu.data.datasets import HowTo100MSource
+
+    return HowTo100MSource(cfg.data, cfg.model)
+
+
+@dataclass
+class TrainResult:
+    state: TrainState
+    steps: int
+    last_loss: float
+
+
+def _in_training_eval(cfg: Config, model, state: TrainState, mesh,
+                      logger) -> None:
+    """HMDB linear probe during training (the reference's intent at
+    main_distributed.py:243-287)."""
+    from milnce_tpu.data.datasets import HMDBSource
+    from milnce_tpu.eval.linear_probe import evaluate_linear_probe
+
+    source = HMDBSource(cfg.data.eval_csv, cfg.data.eval_video_root,
+                        cfg.data, num_clip=cfg.train.num_windows_test)
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+    accs = evaluate_linear_probe(model, variables, source, mesh)
+    logger.log(f"HMDB linear probe: {accs}")
+
+
+def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
+    initialize_distributed(cfg.parallel)
+    mesh = build_mesh(cfg.parallel)
+    axis = cfg.parallel.data_axis
+
+    logger = RunLogger(cfg.train.log_root, cfg.train.checkpoint_dir,
+                       enabled=jax.process_index() == 0 and cfg.train.verbose)
+    logger.log(f"mesh: {mesh.shape} | devices: {len(jax.devices())} "
+               f"| global batch: {cfg.train.batch_size}")
+
+    source = build_source(cfg)
+    loader = ShardedLoader(source, cfg.train.batch_size, seed=cfg.train.seed,
+                           num_threads=cfg.data.num_reader_threads)
+    steps_per_epoch = loader.steps_per_epoch()
+    assert steps_per_epoch > 0, "dataset smaller than one global batch"
+
+    model = build_model(cfg.model, bn_axis_name=axis)
+    rng = jax.random.PRNGKey(cfg.train.seed)
+    sample_video = np.zeros((2, cfg.data.num_frames, cfg.data.video_size,
+                             cfg.data.video_size, 3), np.float32)
+    sample_text = np.zeros((2 * cfg.data.num_candidates, cfg.data.max_words),
+                           np.int32)
+    variables = model.init(rng, sample_video, sample_text)
+    if cfg.train.pretrain_ckpt:
+        # converted reference weights (main_distributed.py:81-83)
+        import torch
+
+        from milnce_tpu.utils.torch_convert import torch_state_dict_to_flax
+
+        raw = torch.load(cfg.train.pretrain_ckpt, map_location="cpu",
+                         weights_only=False)
+        sd = raw.get("state_dict", raw)
+        converted = torch_state_dict_to_flax(
+            {k: v.numpy() for k, v in sd.items() if hasattr(v, "numpy")})
+        variables = converted
+        logger.log(f"loaded pretrained weights from {cfg.train.pretrain_ckpt}")
+
+    schedule = build_schedule(cfg.optim, steps_per_epoch)
+    optimizer = build_optimizer(cfg.optim, schedule)
+    state = create_train_state(variables, optimizer)
+
+    ckpt_dir = os.path.join(cfg.train.checkpoint_root,
+                            cfg.train.checkpoint_dir or "run")
+    manager = CheckpointManager(ckpt_dir, keep=cfg.train.checkpoint_keep)
+    start_epoch = 0
+    if cfg.train.resume:
+        start_epoch, state = manager.restore_latest(state)
+        # restored arrays are committed to one device; re-replicate over the
+        # mesh so they compose with the batch-sharded step inputs
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        state = jax.device_put(state, NamedSharding(mesh, P()))
+        logger.log(f"resumed from epoch {start_epoch}")
+
+    step_fn = make_train_step(model, optimizer, mesh, data_axis=axis,
+                              loss_cfg=cfg.loss)
+
+    # Preemption-safe shutdown: TPU-VM maintenance events deliver SIGTERM;
+    # save a checkpoint and exit cleanly instead of losing the epoch (the
+    # reference has no preemption handling — SURVEY.md §5 failure-detection
+    # note; recovery there is manual restart from the last epoch file).
+    preempted = {"flag": False}
+
+    def _on_sigterm(signum, frame):
+        preempted["flag"] = True
+
+    try:
+        prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:           # non-main thread (tests)
+        prev_handler = None
+
+    # In-training eval cadence: every total_batch//512 epochs, like the
+    # reference's gate (main_distributed.py:188-189) — which is dead code
+    # there (undefined test_loader, SURVEY.md §2.4); here it works.
+    eval_every = max(1, cfg.train.batch_size // 512)
+
+    total_steps = 0
+    last_loss = float("nan")
+    running = 0.0
+    window = 0
+    tick = time.time()
+    try:
+        for epoch in range(start_epoch, cfg.optim.epochs):
+            if (cfg.train.evaluate and cfg.data.eval_video_root
+                    and epoch % eval_every == 0):
+                _in_training_eval(cfg, model, state, mesh, logger)
+            for batch in device_prefetch(loader.epoch(epoch), mesh, axis,
+                                         depth=cfg.data.prefetch_depth):
+                video, text = flatten_text(batch)
+                start = batch.get(
+                    "start", np.zeros((video.shape[0],), np.float32))
+                state, loss = step_fn(state, video, text, start)
+                total_steps += 1
+                window += 1
+                running += float(loss)
+                last_loss = float(loss)
+                if window % cfg.train.n_display == 0:
+                    # LR + progress from the RESTORED step counter, so they
+                    # stay correct across resumes.
+                    opt_step = int(state.step)
+                    lr = float(schedule(opt_step))
+                    progress = (opt_step % steps_per_epoch) / steps_per_epoch
+                    logger.log(
+                        f"Epoch {epoch + 1}, Elapsed Time: "
+                        f"{time.time() - tick:.3f}, Epoch status: "
+                        f"{progress:.4f}, Training loss: "
+                        f"{running / window:.4f}, Learning rate: {lr:.6f}")
+                    running = 0.0
+                    window = 0
+                    tick = time.time()
+                if preempted["flag"] or (max_steps is not None
+                                         and total_steps >= max_steps):
+                    if preempted["flag"]:
+                        logger.log("SIGTERM — checkpointing and exiting")
+                    # mid-epoch stop: label the checkpoint with the CURRENT
+                    # epoch so resume re-runs it (labelling epoch+1 would
+                    # silently skip the epoch's remaining batches)
+                    manager.save(epoch, state)
+                    manager.wait()
+                    return TrainResult(state, total_steps, last_loss)
+            manager.save(epoch + 1, state)
+    finally:
+        manager.wait()
+        if prev_handler is not None:
+            signal.signal(signal.SIGTERM, prev_handler)
+    return TrainResult(state, total_steps, last_loss)

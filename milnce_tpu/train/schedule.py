@@ -1,0 +1,32 @@
+"""LR schedules.
+
+Cosine-with-warmup matching the reference (utils.py:26-38): linear warmup
+over ``num_warmup_steps`` then ``max(0, 0.5*(1 + cos(pi * num_cycles * 2 *
+progress)))``, stepped PER BATCH (main_distributed.py:240).  Expressed as
+an optax schedule (pure fn of the step) instead of a stateful LambdaLR.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from milnce_tpu.config import OptimConfig
+
+
+def cosine_with_warmup(base_lr: float, num_warmup_steps: int,
+                       num_training_steps: int, num_cycles: float = 0.5):
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warmup = step / jnp.maximum(1.0, num_warmup_steps)
+        progress = (step - num_warmup_steps) / jnp.maximum(
+            1.0, num_training_steps - num_warmup_steps)
+        cosine = jnp.maximum(
+            0.0, 0.5 * (1.0 + jnp.cos(jnp.pi * num_cycles * 2.0 * progress)))
+        return base_lr * jnp.where(step < num_warmup_steps, warmup, cosine)
+
+    return schedule
+
+
+def build_schedule(cfg: OptimConfig, steps_per_epoch: int):
+    total = steps_per_epoch * cfg.epochs
+    return cosine_with_warmup(cfg.lr, cfg.warmup_steps, total, cfg.num_cycles)

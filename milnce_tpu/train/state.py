@@ -1,0 +1,53 @@
+"""Train state + optimizer factory.
+
+Replaces the reference's torch Adam/SGD setup (main_distributed.py:154-157)
+with optax; the schedule is folded into the optimizer via
+``optax.inject_hyperparams`` so the current LR is observable for logging
+(the reference reads ``optimizer.param_groups[0]['lr']``,
+main_distributed.py:220).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import optax
+from flax import struct
+
+from milnce_tpu.config import OptimConfig
+
+
+@struct.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+
+
+def build_optimizer(cfg: OptimConfig, schedule) -> optax.GradientTransformation:
+    if cfg.name == "adam":
+        opt = optax.inject_hyperparams(optax.adam)(learning_rate=schedule)
+    elif cfg.name == "sgd":
+        opt = optax.inject_hyperparams(optax.sgd)(
+            learning_rate=schedule, momentum=cfg.momentum)
+    else:
+        raise ValueError(f"unknown optimizer {cfg.name!r}")
+    return opt
+
+
+def create_train_state(variables, optimizer) -> TrainState:
+    import jax.numpy as jnp
+
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=variables["params"],
+        batch_stats=variables.get("batch_stats", {}),
+        opt_state=optimizer.init(variables["params"]),
+    )
+
+
+def current_lr(state: TrainState) -> float:
+    """Read the LR that the last/next step uses (for n_display logging)."""
+    return float(state.opt_state.hyperparams["learning_rate"])

@@ -1,0 +1,25 @@
+"""Run logger: stdout + append-only file under log_root
+(reference: main_distributed.py:304-306, rank-0 gated at call sites)."""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+class RunLogger:
+    def __init__(self, log_root: str, run_name: str = "", enabled: bool = True):
+        self.enabled = enabled
+        self.path = None
+        if enabled and log_root:
+            os.makedirs(log_root, exist_ok=True)
+            self.path = os.path.join(log_root, (run_name or "run") + ".log")
+
+    def log(self, message: str) -> None:
+        if not self.enabled:
+            return
+        line = f"[{time.strftime('%H:%M:%S')}] {message}"
+        print(line, flush=True)
+        if self.path:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")

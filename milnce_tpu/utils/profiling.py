@@ -1,0 +1,50 @@
+"""Profiling / step-timing utilities.
+
+The reference's only instrumentation is ad-hoc ``time.time()`` deltas
+(main_distributed.py:204-224, with ``d_step`` computed then unused);
+here: a windowed step timer (steps/sec, clips/sec) and an optional
+``jax.profiler`` trace context for real TPU traces (SURVEY.md §5
+tracing note).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+
+class StepTimer:
+    """Windowed throughput meter."""
+
+    def __init__(self, clips_per_step: int):
+        self.clips_per_step = clips_per_step
+        self.reset()
+
+    def reset(self) -> None:
+        self._t0 = time.perf_counter()
+        self._steps = 0
+
+    def tick(self) -> None:
+        self._steps += 1
+
+    @property
+    def steps_per_sec(self) -> float:
+        dt = time.perf_counter() - self._t0
+        return self._steps / dt if dt > 0 else 0.0
+
+    @property
+    def clips_per_sec(self) -> float:
+        return self.steps_per_sec * self.clips_per_step
+
+
+@contextlib.contextmanager
+def maybe_trace(log_dir: str | None):
+    """``with maybe_trace('/tmp/trace'):`` wraps the block in a
+    ``jax.profiler`` trace when a directory is given; no-op otherwise."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

@@ -1,0 +1,134 @@
+"""Data layer: caption/candidate sampling, decode helpers, dataset sources
+(hermetic via FakeDecoder; behavior spec: reference video_loader.py and
+the three eval loaders)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from milnce_tpu.config import tiny_preset
+from milnce_tpu.data.captions import (CaptionTrack, nearest_candidate_window,
+                                      sample_caption, widen_to_min_time)
+from milnce_tpu.data.tokenizer import Tokenizer
+from milnce_tpu.data.video import FakeDecoder, eval_windows, pad_or_trim
+
+
+def track(starts, ends, texts=None):
+    return CaptionTrack(np.asarray(starts, float), np.asarray(ends, float),
+                        texts or [f"t{i}" for i in range(len(starts))])
+
+
+class TestCandidateWindow:
+    def test_middle_grows_to_nearest(self):
+        # captions at [0,10],[10,12],[12,14],[14,16],[30,40]; ind=2, K=3:
+        # growing left (12-10=2 wider span) vs right (16-12) chooses left
+        t = track([0, 10, 12, 14, 30], [10, 12, 14, 16, 40])
+        start = nearest_candidate_window(t, 2, 3)
+        assert start == 1  # window {1,2,3}: tight middle captions
+
+    def test_left_edge_clamps_to_zero(self):
+        t = track([0, 5, 10], [5, 10, 15])
+        assert nearest_candidate_window(t, 0, 3) == 0
+
+    def test_right_edge_backfills(self):
+        t = track([0, 5, 10, 15], [5, 10, 15, 20])
+        # ind at last caption: window backfills from the left
+        assert nearest_candidate_window(t, 3, 3) == 1
+
+    def test_k1_is_identity(self):
+        t = track([0, 5], [5, 10])
+        assert nearest_candidate_window(t, 1, 1) == 1
+
+
+class TestWidenMinTime:
+    def test_short_clip_widened_centered(self):
+        s, e = widen_to_min_time(10.0, 11.0, 5.0)
+        assert (s, e) == (8, 13)
+
+    def test_clamped_at_zero(self):
+        s, e = widen_to_min_time(0.5, 1.0, 5.0)
+        assert s == 0 and e == 5
+
+    def test_long_clip_untouched(self):
+        assert widen_to_min_time(3.0, 20.0, 5.0) == (3, 20)
+
+
+def test_sample_caption_shapes_and_determinism():
+    t = track([0, 5, 10, 15], [5, 10, 15, 20],
+              ["word1 word2", "word3", "word1", "word2 word3"])
+    tok = Tokenizer([f"word{i}" for i in range(1, 6)], max_words=4)
+    tokens, start, end = sample_caption(t, np.random.RandomState(0), tok,
+                                        num_candidates=3, max_words=4,
+                                        min_time=5.0)
+    assert tokens.shape == (3, 4) and tokens.dtype == np.int32
+    assert end - start >= 5
+    tokens2, *_ = sample_caption(t, np.random.RandomState(0), tok, 3, 4, 5.0)
+    np.testing.assert_array_equal(tokens, tokens2)
+
+
+def test_pad_or_trim():
+    x = np.ones((5, 4, 4, 3), np.uint8)
+    assert pad_or_trim(x, 8).shape == (8, 4, 4, 3)
+    assert pad_or_trim(x, 8)[5:].sum() == 0  # zero tail
+    assert pad_or_trim(x, 3).shape == (3, 4, 4, 3)
+
+
+def test_eval_windows_deterministic_and_shaped():
+    dec = FakeDecoder()
+    w1 = eval_windows(dec, "vid.mp4", 0.0, 30.0, num_clip=4, num_frames=4,
+                      fps=2, size=8)
+    w2 = eval_windows(dec, "vid.mp4", 0.0, 30.0, num_clip=4, num_frames=4,
+                      fps=2, size=8)
+    assert w1.shape == (4, 4, 8, 8, 3) and w1.dtype == np.uint8
+    np.testing.assert_array_equal(w1, w2)
+
+
+@pytest.fixture
+def howto_dir(tmp_path):
+    """Tiny on-disk HowTo100M layout: manifest csv + caption JSONs."""
+    (tmp_path / "videos").mkdir()
+    (tmp_path / "captions").mkdir()
+    rows = ["video_path"]
+    for i in range(4):
+        vid = f"vid{i}"
+        rows.append(f"{vid}.mp4")
+        caps = {"start": [0, 6, 12], "end": [6, 12, 18],
+                "text": [f"word{i} word2", "word3 word4", "word5"]}
+        (tmp_path / "captions" / f"{vid}.json").write_text(json.dumps(caps))
+    (tmp_path / "train.csv").write_text("\n".join(rows))
+    return tmp_path
+
+
+def test_howto100m_source(howto_dir):
+    from milnce_tpu.data.datasets import HowTo100MSource
+
+    cfg = tiny_preset()
+    cfg.data.train_csv = str(howto_dir / "train.csv")
+    cfg.data.video_root = str(howto_dir / "videos")
+    cfg.data.caption_root = str(howto_dir / "captions")
+    cfg.data.num_candidates = 3
+    tok = Tokenizer([f"word{i}" for i in range(1, 8)], cfg.data.max_words)
+    src = HowTo100MSource(cfg.data, cfg.model, decoder=FakeDecoder(),
+                          tokenizer=tok)
+    assert len(src) == 4
+    s = src.sample(1, np.random.RandomState(0))
+    c = cfg.data
+    assert s["video"].shape == (c.num_frames, c.video_size, c.video_size, 3)
+    assert s["video"].dtype == np.uint8
+    assert s["text"].shape == (3, c.max_words)
+
+
+def test_hmdb_label_stripping():
+    from milnce_tpu.data.datasets import HMDBSource
+
+    assert HMDBSource.label_of("brush_hair_test") == "brush_hair"
+    assert HMDBSource.label_of("wave") == "wave"
+
+
+def test_ffmpeg_decoder_gated_without_binary(monkeypatch):
+    from milnce_tpu.data.video import FFmpegDecoder
+
+    dec = FFmpegDecoder(binary="definitely-not-a-binary-xyz")
+    with pytest.raises(RuntimeError, match="synthetic"):
+        dec.decode("x.mp4", 0, 1.0, 2, 8)

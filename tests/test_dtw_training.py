@@ -1,0 +1,84 @@
+"""DTW-loss training path: sequence-mode model + loss dispatch + sharded
+step (the fork's temporal-alignment training, made runnable — its
+committed trainers are import-broken, SURVEY.md §2.4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from milnce_tpu.config import LossConfig
+
+
+def _tiny_model():
+    from milnce_tpu.models import S3D
+
+    return S3D(num_classes=16, vocab_size=64, word_embedding_dim=8,
+               text_hidden_dim=16)
+
+
+def test_sequence_mode_shapes():
+    model = _tiny_model()
+    video = jnp.zeros((2, 8, 32, 32, 3), jnp.float32)
+    text = jnp.zeros((6, 5), jnp.int32)          # B*K rows, K=3
+    variables = model.init(jax.random.PRNGKey(0), video, text)
+    v_seq, t_emb = model.apply(variables, video, text, mode="sequence")
+    # T=8 -> conv1 stride 2 -> 4 -> maxpool_4a -> 2 -> maxpool_5a -> 1
+    assert v_seq.shape == (2, 1, 16)
+    assert t_emb.shape == (6, 16)
+
+
+@pytest.mark.parametrize("loss_name", ["cdtw", "sdtw_cidm", "sdtw_negative",
+                                       "sdtw_3"])
+def test_dtw_loss_train_step(loss_name):
+    from milnce_tpu.config import OptimConfig
+    from milnce_tpu.train.schedule import build_schedule
+    from milnce_tpu.train.state import build_optimizer, create_train_state
+    from milnce_tpu.train.step import make_train_step
+
+    model = _tiny_model()
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    b, k, frames, size, words = 8, 2, 8, 32, 5
+    rng = np.random.RandomState(0)
+    video = rng.randint(0, 255, (b, frames, size, size, 3), np.uint8)
+    text = rng.randint(0, 64, (b * k, words)).astype(np.int32)
+    start = (np.arange(b) * 7.0).astype(np.float32)
+
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, frames, size, size, 3)),
+                           jnp.zeros((2 * k, words), jnp.int32))
+    optim_cfg = OptimConfig(warmup_steps=2)
+    optimizer = build_optimizer(optim_cfg, build_schedule(optim_cfg, 10))
+    state = create_train_state(variables, optimizer)
+    step_fn = make_train_step(model, optimizer, mesh,
+                              loss_cfg=LossConfig(name=loss_name))
+
+    sh = NamedSharding(mesh, P("data"))
+    state, loss = step_fn(state,
+                          jax.device_put(video, sh),
+                          jax.device_put(text, sh),
+                          jax.device_put(start, sh))
+    assert np.isfinite(float(loss)), (loss_name, float(loss))
+    assert int(state.step) == 1
+
+
+def test_unknown_loss_rejected():
+    from milnce_tpu.config import OptimConfig
+    from milnce_tpu.train.schedule import build_schedule
+    from milnce_tpu.train.state import build_optimizer, create_train_state
+    from milnce_tpu.train.step import make_train_step
+
+    model = _tiny_model()
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, 8, 32, 32, 3)),
+                           jnp.zeros((4, 5), jnp.int32))
+    optim_cfg = OptimConfig(warmup_steps=2)
+    optimizer = build_optimizer(optim_cfg, build_schedule(optim_cfg, 10))
+    state = create_train_state(variables, optimizer)
+    step_fn = make_train_step(model, optimizer, mesh,
+                              loss_cfg=LossConfig(name="bogus"))
+    with pytest.raises(ValueError, match="bogus"):
+        step_fn(state, jnp.zeros((8, 8, 32, 32, 3), jnp.uint8),
+                jnp.zeros((16, 5), jnp.int32), jnp.zeros((8,), jnp.float32))

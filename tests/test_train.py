@@ -1,0 +1,99 @@
+"""End-to-end training slice on the virtual 8-device mesh: the runnable
+equivalent of the reference's train_small path (which is import-broken,
+SURVEY.md §2.4) — config -> synthetic data -> S3D -> sharded MIL-NCE ->
+optimizer -> checkpoint save/resume round-trip."""
+
+import numpy as np
+import pytest
+
+from milnce_tpu.config import tiny_preset
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg(tmp_path_factory):
+    cfg = tiny_preset()
+    base = tmp_path_factory.mktemp("train_run")
+    cfg.train.checkpoint_root = str(base / "ckpt")
+    cfg.train.log_root = str(base / "log")
+    cfg.train.batch_size = 8
+    cfg.data.synthetic_num_samples = 32
+    cfg.data.num_reader_threads = 2
+    return cfg
+
+
+def test_training_runs_and_loss_is_finite(tiny_cfg):
+    from milnce_tpu.train.loop import run_training
+
+    result = run_training(tiny_cfg, max_steps=2)
+    assert result.steps == 2
+    assert np.isfinite(result.last_loss)
+
+
+def test_checkpoint_resume_roundtrip(tiny_cfg, tmp_path):
+    import jax
+
+    from milnce_tpu.train.loop import run_training
+
+    cfg = tiny_cfg
+    cfg.train.checkpoint_root = str(tmp_path / "ckpt2")
+    r1 = run_training(cfg, max_steps=2)
+
+    cfg.train.resume = True
+    cfg.optim.epochs = 2          # resume lands at epoch 1; allow one more
+    r2 = run_training(cfg, max_steps=1)
+    # the restored optimizer step counter carries over (r1 took 2 steps)
+    assert int(r2.state.step) == int(r1.state.step) + 1
+    assert r2.steps == 1
+    assert np.isfinite(r2.last_loss)
+
+
+def test_schedule_matches_reference_shape():
+    """Golden values of the cosine-warmup schedule (utils.py:26-38)."""
+    import math
+
+    from milnce_tpu.train.schedule import cosine_with_warmup
+
+    sched = cosine_with_warmup(1.0, num_warmup_steps=10,
+                               num_training_steps=110, num_cycles=0.5)
+    assert float(sched(0)) == 0.0
+    np.testing.assert_allclose(float(sched(5)), 0.5)
+    np.testing.assert_allclose(float(sched(10)), 1.0, rtol=1e-6)
+    # halfway through decay: progress 0.5 -> 0.5*(1+cos(pi/2)) = 0.5
+    np.testing.assert_allclose(float(sched(60)), 0.5, rtol=1e-5)
+    np.testing.assert_allclose(float(sched(110)), 0.0, atol=1e-6)
+    # quarter: 0.5*(1+cos(pi/4))
+    np.testing.assert_allclose(float(sched(35)),
+                               0.5 * (1 + math.cos(math.pi / 4)), rtol=1e-5)
+
+
+def test_loader_shards_partition_global_batch():
+    from milnce_tpu.data.pipeline import ShardedLoader
+    from milnce_tpu.data.synthetic import SyntheticVideoTextSource
+    from milnce_tpu.config import tiny_preset
+
+    cfg = tiny_preset()
+    src = SyntheticVideoTextSource(cfg.data, num_samples=32)
+    # simulate 2 hosts
+    l0 = ShardedLoader(src, 8, seed=0, num_threads=1, process_index=0,
+                       process_count=2)
+    l1 = ShardedLoader(src, 8, seed=0, num_threads=1, process_index=1,
+                       process_count=2)
+    b0 = next(iter(l0.epoch(0)))
+    b1 = next(iter(l1.epoch(0)))
+    assert b0["video"].shape[0] == 4 and b1["video"].shape[0] == 4
+    # the two hosts' samples are disjoint
+    assert not np.array_equal(b0["video"], b1["video"])
+
+
+def test_loader_epoch_reshuffles():
+    from milnce_tpu.data.pipeline import ShardedLoader
+    from milnce_tpu.data.synthetic import SyntheticVideoTextSource
+    from milnce_tpu.config import tiny_preset
+
+    cfg = tiny_preset()
+    src = SyntheticVideoTextSource(cfg.data, num_samples=64)
+    loader = ShardedLoader(src, 16, seed=0, num_threads=1, process_index=0,
+                           process_count=1)
+    e0 = next(iter(loader.epoch(0)))
+    e1 = next(iter(loader.epoch(1)))
+    assert not np.array_equal(e0["video"], e1["video"])
