@@ -56,6 +56,8 @@ class ModelConfig:
     sync_batchnorm: bool = False        # cross-replica BN (original TPU run); False = local
                                         # BN for parity with the GPU reference (README.md:13)
     dtype: str = "float32"              # activation dtype ('bfloat16' for MXU speed)
+    remat: bool = False                 # rematerialize Inception blocks
+                                        # (jax.checkpoint) to fit big batches
 
 
 @dataclass

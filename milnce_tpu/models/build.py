@@ -48,5 +48,6 @@ def build_model(cfg: ModelConfig, bn_axis_name: str | None = None) -> S3D:
         weight_init=cfg.weight_init,
         bn_axis_name=bn_axis_name if cfg.sync_batchnorm else None,
         embedding_init=embedding_init,
+        remat=cfg.remat,
         dtype=jnp.dtype(cfg.dtype),
     )

@@ -230,12 +230,16 @@ class S3D(nn.Module):
     weight_init: str = "uniform"
     bn_axis_name: Optional[str] = None
     embedding_init: Optional[Callable] = None
+    remat: bool = False                 # rematerialize Inception blocks to
+                                        # trade FLOPs for HBM at big batches
     dtype: Any = jnp.float32
 
     def setup(self):
         ki = kernel_init_for(self.weight_init)
         common = dict(bn_axis_name=self.bn_axis_name, kernel_init=ki,
                       dtype=self.dtype)
+        block_cls = (nn.remat(InceptionBlock, static_argnums=(2,))
+                     if self.remat else InceptionBlock)
         if self.use_space_to_depth:
             # reference s3dg.py:215 (+ the post-conv crop in forward_video)
             self.conv1 = STConv3D(64, (2, 4, 4), strides=1, padding=(1, 2, 2),
@@ -249,23 +253,23 @@ class S3D(nn.Module):
                                 name="conv_2c", **common)
         self.stem_gating = SelfGating(ki, self.dtype, name="gating")
         blocks = dict(gating=self.gating, **common)
-        self.mixed_3b = InceptionBlock(64, 96, 128, 16, 32, 32,
+        self.mixed_3b = block_cls(64, 96, 128, 16, 32, 32,
                                        name="mixed_3b", **blocks)
-        self.mixed_3c = InceptionBlock(128, 128, 192, 32, 96, 64,
+        self.mixed_3c = block_cls(128, 128, 192, 32, 96, 64,
                                        name="mixed_3c", **blocks)
-        self.mixed_4b = InceptionBlock(192, 96, 208, 16, 48, 64,
+        self.mixed_4b = block_cls(192, 96, 208, 16, 48, 64,
                                        name="mixed_4b", **blocks)
-        self.mixed_4c = InceptionBlock(160, 112, 224, 24, 64, 64,
+        self.mixed_4c = block_cls(160, 112, 224, 24, 64, 64,
                                        name="mixed_4c", **blocks)
-        self.mixed_4d = InceptionBlock(128, 128, 256, 24, 64, 64,
+        self.mixed_4d = block_cls(128, 128, 256, 24, 64, 64,
                                        name="mixed_4d", **blocks)
-        self.mixed_4e = InceptionBlock(112, 144, 288, 32, 64, 64,
+        self.mixed_4e = block_cls(112, 144, 288, 32, 64, 64,
                                        name="mixed_4e", **blocks)
-        self.mixed_4f = InceptionBlock(256, 160, 320, 32, 128, 128,
+        self.mixed_4f = block_cls(256, 160, 320, 32, 128, 128,
                                        name="mixed_4f", **blocks)
-        self.mixed_5b = InceptionBlock(256, 160, 320, 32, 128, 128,
+        self.mixed_5b = block_cls(256, 160, 320, 32, 128, 128,
                                        name="mixed_5b", **blocks)
-        self.mixed_5c = InceptionBlock(384, 192, 384, 48, 128, 128,
+        self.mixed_5c = block_cls(384, 192, 384, 48, 128, 128,
                                        name="mixed_5c", **blocks)
         # Linear layers stay at torch defaults in both init modes
         # (s3dg.py:240-246 re-inits only convs/BN); mixed_5c dim = 1024.

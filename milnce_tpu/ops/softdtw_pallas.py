@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from milnce_tpu.ops.softdtw import BIG, skew_cost
 
@@ -83,6 +84,143 @@ def _fwd_kernel(d_ref, val_ref, r_ref, *, n: int, m: int, gamma: float,
 
     lax.fori_loop(2, n + m + 1, body, 0)
     val_ref[0, 0] = r_ref[0, n + m, n]
+
+
+def _fwd_kernel_chunked(d_ref, val_ref, r_ref, carry, *, n: int, m: int,
+                        gamma: float, bandwidth: int, chunk: int):
+    """Streaming forward: grid (B, n_chunks), diagonals arrive in
+    CHUNK-sized blocks from HBM; only two carry rows live across chunks
+    (VMEM scratch).  Removes the all-diagonals-in-VMEM requirement, so the
+    sequence-length ceiling is HBM, not VMEM (the reference's ceiling was
+    1024 CUDA threads, soft_dtw_cuda.py:318-320).
+
+    Block t of chunk c holds diagonal p = c*chunk + t + 2; r_ref stores
+    diagonals >= 2 (diagonals 0/1 are constants, re-attached host-side).
+    """
+    n1 = n + 1
+    c = pl.program_id(1)
+    i_buf = lax.broadcasted_iota(jnp.int32, (1, n1), 1)
+    inv_gamma = 1.0 / gamma
+
+    @pl.when(c == 0)
+    def _init():
+        carry[0, :] = jnp.where(i_buf == 0, 0.0, BIG)[0]     # diag 0
+        carry[1, :] = jnp.full((n1,), BIG, jnp.float32)      # diag 1
+
+    def body(t, _):
+        p = c * chunk + t + 2
+        r_mm = carry[0, :][None, :]
+        r_m = carry[1, :][None, :]
+        cost = d_ref[0, t, :][None, :]
+        n0 = -r_mm[:, :-1] * inv_gamma
+        n1_ = -r_m[:, :-1] * inv_gamma
+        n2 = -r_m[:, 1:] * inv_gamma
+        mx = jnp.maximum(jnp.maximum(n0, n1_), n2)
+        softmin = -gamma * (jnp.log(jnp.exp(n0 - mx) + jnp.exp(n1_ - mx)
+                                    + jnp.exp(n2 - mx)) + mx)
+        row = jnp.concatenate(
+            [jnp.full((1, 1), BIG, jnp.float32), cost + softmin], axis=1)
+        j_buf = p - i_buf
+        valid = ((i_buf >= 1) & (j_buf >= 1) & (j_buf <= m))
+        if bandwidth > 0:
+            valid &= jnp.abs(i_buf - j_buf) <= bandwidth
+        row = jnp.where(valid, row, BIG)[0]
+        r_ref[0, t, :] = row
+        carry[0, :] = r_m[0]
+        carry[1, :] = row
+
+        @pl.when(p == n + m)
+        def _final():
+            val_ref[0, 0] = row[n]
+
+        return 0
+
+    lax.fori_loop(0, chunk, body, 0)
+
+
+def _run_forward_chunked(d_skew: jax.Array, n: int, m: int, gamma: float,
+                         bandwidth: int, chunk: int):
+    """d_skew: (B, N+M-1, N) -> (value (B,), r_skew (B, N+M+1, N+1))."""
+    import math
+
+    bsz = d_skew.shape[0]
+    n_diag = n + m - 1                    # diagonals 2..n+m
+    n_chunks = math.ceil(n_diag / chunk)
+    pad_p = n_chunks * chunk - n_diag
+    d_pad = jnp.pad(d_skew, ((0, 0), (0, pad_p), (0, 0)))
+    kernel = functools.partial(_fwd_kernel_chunked, n=n, m=m, gamma=gamma,
+                               bandwidth=bandwidth, chunk=chunk)
+    value, r_body = pl.pallas_call(
+        kernel,
+        grid=(bsz, n_chunks),
+        in_specs=[pl.BlockSpec((1, chunk, n), lambda b, c: (b, c, 0))],
+        out_specs=[pl.BlockSpec((1, 1), lambda b, c: (b, 0)),
+                   pl.BlockSpec((1, chunk, n + 1), lambda b, c: (b, c, 0))],
+        out_shape=[jax.ShapeDtypeStruct((bsz, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((bsz, n_chunks * chunk, n + 1),
+                                        jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((2, n + 1), jnp.float32)],
+        interpret=_interpret(),
+    )(d_pad)
+    # re-attach the constant diagonals 0 and 1
+    diag0 = jnp.where(jnp.arange(n + 1) == 0, 0.0, BIG)
+    head = jnp.stack([diag0, jnp.full((n + 1,), BIG)], axis=0)
+    head = jnp.broadcast_to(head[None], (bsz, 2, n + 1))
+    r_skew = jnp.concatenate([head, r_body[:, :n_diag]], axis=1)
+    return value[:, 0], r_skew
+
+
+def _softdtw_bwd_scan(r_ext: jax.Array, d_ext_skew: jax.Array, n: int,
+                      m: int, gamma: float, bandwidth: int) -> jax.Array:
+    """Any-length backward: the reverse-wavefront E recurrence as a
+    lax.scan over diagonals (rows stream from HBM automatically).  Used
+    when the whole table exceeds the Pallas kernel's VMEM budget."""
+    bsz = r_ext.shape[0]
+    n2 = n + 2
+    i_buf = jnp.arange(n2)
+    inv_gamma = 1.0 / gamma
+
+    def shift_left(row):
+        return jnp.concatenate(
+            [row[:, 1:], jnp.zeros((bsz, 1), row.dtype)], axis=1)
+
+    def step(carry, inputs):
+        e_q1, e_q2 = carry                     # diagonals q+1, q+2
+        r_q, r_q1, r_q2, d_q1, d_q2, q = inputs
+        a = jnp.exp((shift_left(r_q1) - r_q - shift_left(d_q1)) * inv_gamma)
+        b_ = jnp.exp((r_q1 - r_q - d_q1) * inv_gamma)
+        c = jnp.exp((shift_left(r_q2) - r_q - shift_left(d_q2)) * inv_gamma)
+        e_row = shift_left(e_q1) * a + e_q1 * b_ + shift_left(e_q2) * c
+        j_buf = q - i_buf
+        valid = ((i_buf >= 1) & (i_buf <= n) & (j_buf >= 1) & (j_buf <= m))
+        valid = valid[None, :] & (r_q > -BIG / 2)
+        if bandwidth > 0:
+            valid &= (jnp.abs(i_buf - j_buf) <= bandwidth)[None, :]
+        e_row = jnp.where(valid, e_row, 0.0)
+        return (e_row, e_q1), e_row
+
+    # iterate q = n+m down to 2; inputs pre-gathered per diagonal
+    qs = jnp.arange(n + m, 1, -1)
+    r_q = r_ext[:, qs, :]
+    r_q1 = r_ext[:, qs + 1, :]
+    r_q2 = r_ext[:, qs + 2, :]
+    d_q1 = d_ext_skew[:, qs + 1, :]
+    d_q2 = d_ext_skew[:, qs + 2, :]
+    swap = lambda x: x.transpose(1, 0, 2)
+    e_init_q2 = jnp.zeros((bsz, n2), jnp.float32).at[:, n + 1].set(1.0)
+    e_init_q1 = jnp.zeros((bsz, n2), jnp.float32)
+    (_, _), e_rows = lax.scan(
+        step, (e_init_q1, e_init_q2),
+        (swap(r_q), swap(r_q1), swap(r_q2), swap(d_q1), swap(d_q2), qs))
+    # e_rows[k] = diagonal q = n+m-k; build skewed E table rows 0..n+m+2
+    e_skew = jnp.zeros((bsz, n + m + 3, n2), jnp.float32)
+    e_skew = e_skew.at[:, qs, :].set(swap(e_rows))
+    return e_skew
+
+
+# Largest (N+M+3) x (N+2) f32 table we let the single-block kernels hold in
+# VMEM (~16 MB/core, leave headroom for D and E).
+_VMEM_TABLE_BUDGET = 2_000_000  # floats
 
 
 def _run_forward(d_skew: jax.Array, n: int, m: int, gamma: float,
@@ -185,10 +323,21 @@ def softdtw_pallas(D: jax.Array, gamma: float = 1.0,
     return value
 
 
+def _table_fits_vmem(n: int, m: int) -> bool:
+    return (n + m + 3) * (n + 2) <= _VMEM_TABLE_BUDGET
+
+
 def _softdtw_pallas_fwd(D, gamma, bandwidth):
     _, n, m = D.shape
     d_skew = skew_cost(D.astype(jnp.float32))
-    value, r_skew = _run_forward(d_skew, n, m, float(gamma), int(bandwidth))
+    if _table_fits_vmem(n, m):
+        value, r_skew = _run_forward(d_skew, n, m, float(gamma),
+                                     int(bandwidth))
+    else:
+        # long-sequence path: stream diagonals in chunks
+        chunk = max(8, _VMEM_TABLE_BUDGET // (4 * (n + 1)))
+        value, r_skew = _run_forward_chunked(d_skew, n, m, float(gamma),
+                                             int(bandwidth), chunk)
     return value, (D, r_skew)
 
 
@@ -203,8 +352,12 @@ def _softdtw_pallas_bwd(gamma, bandwidth, residuals, grad_out):
     # Padded costs D_[i, j] (zeros border), skewed to match.
     d_ext = jnp.pad(D.astype(jnp.float32), ((0, 0), (1, 1), (1, 1)))
     d_ext_skew = skew_cost(d_ext)                   # (B, N+M+3, N+2)
-    e_skew = _run_backward(r_ext, d_ext_skew, n, m, float(gamma),
-                           int(bandwidth))
+    if _table_fits_vmem(n, m):
+        e_skew = _run_backward(r_ext, d_ext_skew, n, m, float(gamma),
+                               int(bandwidth))
+    else:
+        e_skew = _softdtw_bwd_scan(r_ext, d_ext_skew, n, m, float(gamma),
+                                   int(bandwidth))
     # grad_D[i, j] = g * E[i+1, j+1]  (skewed: diag i+j+2, idx i+1)
     i_idx = jnp.arange(n)[:, None]
     j_idx = jnp.arange(m)[None, :]
