@@ -46,6 +46,8 @@ class ModelConfig:
     embedding_dim: int = 512            # args.py `--num_class`
     gating: bool = True
     space_to_depth: bool = False
+    inception_blocks: int = 9           # trunk depth (9 = full S3D-G;
+                                        # smaller for dryruns/ablations)
     weight_init: str = "uniform"        # 'uniform' (framework default) | 'kaiming_normal'
     vocab_size: int = 66250             # s3dg.py:152
     word_embedding_dim: int = 300

@@ -42,6 +42,7 @@ def build_model(cfg: ModelConfig, bn_axis_name: str | None = None) -> S3D:
         num_classes=cfg.embedding_dim,
         gating=cfg.gating,
         use_space_to_depth=cfg.space_to_depth,
+        inception_blocks=cfg.inception_blocks,
         vocab_size=vocab_size,
         word_embedding_dim=cfg.word_embedding_dim,
         text_hidden_dim=cfg.text_hidden_dim,

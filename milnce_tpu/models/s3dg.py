@@ -224,6 +224,10 @@ class S3D(nn.Module):
     num_classes: int = 512
     gating: bool = True
     use_space_to_depth: bool = False
+    inception_blocks: int = 9           # trunk depth: first N of the 9
+                                        # Inception blocks (9 = reference
+                                        # s3dg.py:223-233; smaller values
+                                        # give cheap variants for dryruns)
     vocab_size: int = 66250
     word_embedding_dim: int = 300
     text_hidden_dim: int = 2048
@@ -235,6 +239,8 @@ class S3D(nn.Module):
     dtype: Any = jnp.float32
 
     def setup(self):
+        assert 1 <= self.inception_blocks <= 9, (
+            f"inception_blocks must be in [1, 9], got {self.inception_blocks}")
         ki = kernel_init_for(self.weight_init)
         common = dict(bn_axis_name=self.bn_axis_name, kernel_init=ki,
                       dtype=self.dtype)
@@ -272,9 +278,14 @@ class S3D(nn.Module):
         self.mixed_5c = block_cls(384, 192, 384, 48, 128, 128,
                                        name="mixed_5c", **blocks)
         # Linear layers stay at torch defaults in both init modes
-        # (s3dg.py:240-246 re-inits only convs/BN); mixed_5c dim = 1024.
+        # (s3dg.py:240-246 re-inits only convs/BN); fan-in = output dim of
+        # the last active block (1024 for the full mixed_5c trunk).
+        all_blocks = (self.mixed_3b, self.mixed_3c, self.mixed_4b,
+                      self.mixed_4c, self.mixed_4d, self.mixed_4e,
+                      self.mixed_4f, self.mixed_5b, self.mixed_5c)
+        trunk_dim = all_blocks[self.inception_blocks - 1].output_dim
         self.fc = nn.Dense(self.num_classes, kernel_init=torch_default_kernel(),
-                           bias_init=torch_bias(1024),
+                           bias_init=torch_bias(trunk_dim),
                            dtype=self.dtype, name="fc")
         self.text_module = SentenceEmbedding(
             embd_dim=self.num_classes,
@@ -302,17 +313,17 @@ class S3D(nn.Module):
         if self.gating:
             net = self.stem_gating(net)
         net = _tf_same_max_pool(net, (1, 3, 3), (1, 2, 2))   # maxpool_3a
-        net = self.mixed_3b(net, train)
-        net = self.mixed_3c(net, train)
-        net = _tf_same_max_pool(net, (3, 3, 3), (2, 2, 2))   # maxpool_4a
-        net = self.mixed_4b(net, train)
-        net = self.mixed_4c(net, train)
-        net = self.mixed_4d(net, train)
-        net = self.mixed_4e(net, train)
-        net = self.mixed_4f(net, train)
-        net = _tf_same_max_pool(net, (2, 2, 2), (2, 2, 2))   # maxpool_5a
-        net = self.mixed_5b(net, train)
-        net = self.mixed_5c(net, train)
+        blocks = (self.mixed_3b, self.mixed_3c, self.mixed_4b, self.mixed_4c,
+                  self.mixed_4d, self.mixed_4e, self.mixed_4f, self.mixed_5b,
+                  self.mixed_5c)
+        # maxpool_4a before block idx 2, maxpool_5a before idx 7
+        # (reference s3dg.py:223-233 ordering)
+        pools_before = {2: ((3, 3, 3), (2, 2, 2)), 7: ((2, 2, 2), (2, 2, 2))}
+        for idx, block in enumerate(blocks[:self.inception_blocks]):
+            if idx in pools_before:
+                win, strd = pools_before[idx]
+                net = _tf_same_max_pool(net, win, strd)
+            net = block(net, train)
         return net
 
     def forward_video(self, video: Array, mixed5c: bool = False,
