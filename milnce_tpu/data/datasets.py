@@ -54,10 +54,20 @@ def build_tokenizer(model_cfg: ModelConfig, max_words: int) -> Tokenizer:
 
 class HowTo100MSource:
     """Training source: one (video clip, MIL caption bag) per draw
-    (video_loader.py:154-160)."""
+    (video_loader.py:154-160).
+
+    Unlike the reference — where one corrupt file raises through the
+    DataLoader worker and kills the epoch on every node (video_loader.py:
+    85-88 has no error handling; SURVEY.md §7 hard part 2) — a failed
+    caption load or decode resamples a different index (bounded retries),
+    falling back to black frames so a pod step can never stall on a bad
+    video.  Failures are counted in ``decode_failures`` and the first few
+    are logged."""
 
     CAPTION_CACHE_SIZE = 4096   # bounded: 1.2M videos/epoch would otherwise
                                 # accumulate every parsed caption JSON in RAM
+    MAX_RETRIES = 3             # resample attempts before black-frame fallback
+    LOGGED_FAILURES = 5         # stderr-log at most this many failure details
 
     def __init__(self, cfg: DataConfig, model_cfg: ModelConfig,
                  decoder: Optional[ClipDecoder] = None,
@@ -69,6 +79,8 @@ class HowTo100MSource:
         self.tokenizer = tokenizer or build_tokenizer(model_cfg, cfg.max_words)
         self._caption_cache: "OrderedDict[str, CaptionTrack]" = OrderedDict()
         self._cache_lock = threading.Lock()
+        self.decode_failures = 0
+        self._stats_lock = threading.Lock()
 
     def __len__(self) -> int:
         return len(self.rows)
@@ -86,7 +98,7 @@ class HowTo100MSource:
                 self._caption_cache.popitem(last=False)
         return track
 
-    def sample(self, idx: int, rng: np.random.RandomState) -> dict:
+    def _sample_one(self, idx: int, rng: np.random.RandomState) -> dict:
         c = self.cfg
         video_file = self.rows[idx]["video_path"]
         video_id = os.path.basename(video_file).split(".")[0]
@@ -100,6 +112,31 @@ class HowTo100MSource:
                             rng, c.crop_only, c.center_crop, c.random_flip)
         return {"video": video, "text": tokens,
                 "start": np.float32(start)}   # CIDM loss input (loss.py:56)
+
+    def _record_failure(self, idx: int, exc: Exception) -> None:
+        with self._stats_lock:
+            self.decode_failures += 1
+            count = self.decode_failures
+        if count <= self.LOGGED_FAILURES:
+            import sys
+            print(f"[data] sample {idx} failed "
+                  f"({type(exc).__name__}: {exc}); resampling "
+                  f"(total failures: {count})", file=sys.stderr)
+
+    def sample(self, idx: int, rng: np.random.RandomState) -> dict:
+        for _ in range(self.MAX_RETRIES + 1):
+            try:
+                return self._sample_one(idx, rng)
+            except Exception as exc:
+                self._record_failure(idx, exc)
+                idx = int(rng.randint(len(self.rows)))
+        # Last resort (MAX_RETRIES+1 distinct bad draws): black frames +
+        # empty caption bag — a valid, if useless, sample; the step runs.
+        c = self.cfg
+        return {"video": np.zeros((c.num_frames, c.video_size, c.video_size,
+                                   3), np.uint8),
+                "text": np.zeros((c.num_candidates, c.max_words), np.int32),
+                "start": np.float32(0.0)}
 
 
 class YouCookSource:

@@ -23,9 +23,11 @@ def cdtw_batch_loss(video_seq: jax.Array, text_seq: jax.Array,
     scores only the ``args.rank``-th anchor per step; averaging over every
     anchor is the batch-generic equivalent (identical in expectation)."""
     sdtw = SoftDTW(gamma=gamma, dist_func="cosine", backend=backend)
-    pairs = _all_pairs_sdtw(video_seq, text_seq, sdtw)     # (B, B)
-    pos = jnp.diagonal(pairs)
-    neg = jax.nn.logsumexp(pairs, axis=1)
+    pairs = _all_pairs_sdtw(video_seq, text_seq, sdtw)     # pairs[i,j] =
+    pos = jnp.diagonal(pairs)                              #   sdtw(v_j, t_i)
+    # reference anchor r scores its VIDEO against every text
+    # (loss.py:29-30) -> lse over texts = column r = axis 0
+    neg = jax.nn.logsumexp(pairs, axis=0)
     return jnp.mean(pos - neg)
 
 

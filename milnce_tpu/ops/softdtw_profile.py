@@ -1,0 +1,105 @@
+"""Soft-DTW timing + correctness harness.
+
+TPU-native port of the reference's only self-verification tool
+(`/root/reference/soft_dtw_cuda.py:389-463` — ``timed_run``/``profile``):
+times forward+backward of the Pallas kernel against the ``lax.scan``
+golden implementation and asserts they agree, across shape sweeps.
+
+Run standalone on any backend (Pallas runs compiled on TPU, interpret
+elsewhere):
+
+    python -m milnce_tpu.ops.softdtw_profile            # default sweep
+    python -m milnce_tpu.ops.softdtw_profile 32 256 256 512
+
+Unlike the reference, the profile is also exercised in the test suite
+(tests/test_softdtw_pallas.py) — the reference had no tests at all
+(SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timed_run(fn, D, n_iters: int = 6):
+    """Mirror of soft_dtw_cuda.py:389-413: one verification pass with
+    gradients + timed fwd/bwd loop.  Returns (fwd_s, bwd_s, value, grad)."""
+    value_and_grad = jax.jit(jax.value_and_grad(lambda d: jnp.sum(fn(d))))
+    forward = jax.jit(lambda d: fn(d))
+
+    # verification pass (also compiles)
+    value, grad = value_and_grad(D)
+    jax.block_until_ready((value, grad))
+    fwd_only = forward(D)
+    jax.block_until_ready(fwd_only)
+
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        out = forward(D)
+    jax.block_until_ready(out)
+    t_fwd = (time.perf_counter() - t0) / n_iters
+
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        value, grad = value_and_grad(D)
+    jax.block_until_ready(grad)
+    t_bwd = (time.perf_counter() - t0) / n_iters  # fwd+bwd per iter
+
+    return t_fwd, t_bwd, np.asarray(value), np.asarray(grad)
+
+
+def profile(batch_size: int, seq_len_a: int, seq_len_b: int, dims: int,
+            gamma: float = 1.0, n_iters: int = 6, tol: float = 1e-3):
+    """Cross-check scan vs Pallas fwd+bwd and report timings
+    (soft_dtw_cuda.py:416-452).  Returns the result record."""
+    from milnce_tpu.ops.softdtw import softdtw_scan
+    from milnce_tpu.ops.softdtw_pallas import softdtw_pallas
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch_size, seq_len_a, dims).astype(np.float32)
+    y = rng.randn(batch_size, seq_len_b, dims).astype(np.float32)
+    # Euclidean^2 cost keeps the harness focused on the DP kernel itself.
+    D = jnp.asarray(((x[:, :, None, :] - y[:, None, :, :]) ** 2).sum(-1))
+
+    t_fwd_s, t_bwd_s, v_s, g_s = timed_run(
+        lambda d: softdtw_scan(d, gamma), D, n_iters)
+    t_fwd_p, t_bwd_p, v_p, g_p = timed_run(
+        lambda d: softdtw_pallas(d, gamma), D, n_iters)
+
+    # the allclose half of the reference harness (soft_dtw_cuda.py:439-440)
+    assert np.allclose(v_s, v_p, atol=tol, rtol=tol), (
+        f"forward mismatch: max|dv|={np.abs(v_s - v_p).max()}")
+    assert np.allclose(g_s, g_p, atol=tol, rtol=tol), (
+        f"backward mismatch: max|dg|={np.abs(g_s - g_p).max()}")
+
+    backend = jax.default_backend()
+    rec = {
+        "backend": backend,
+        "pallas_compiled": backend == "tpu",
+        "shape": [batch_size, seq_len_a, seq_len_b, dims],
+        "scan_fwd_ms": round(t_fwd_s * 1e3, 3),
+        "scan_fwd_bwd_ms": round(t_bwd_s * 1e3, 3),
+        "pallas_fwd_ms": round(t_fwd_p * 1e3, 3),
+        "pallas_fwd_bwd_ms": round(t_bwd_p * 1e3, 3),
+        "speedup_fwd": round(t_fwd_s / t_fwd_p, 2) if t_fwd_p else None,
+        "speedup_fwd_bwd": round(t_bwd_s / t_bwd_p, 2) if t_bwd_p else None,
+        "allclose": True,
+    }
+    print(json.dumps(rec))
+    return rec
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 5:
+        shapes = [tuple(int(a) for a in sys.argv[1:])]
+    else:
+        # reference presets (soft_dtw_cuda.py:460-463)
+        shapes = [(128, 17, 15, 2), (512, 64, 64, 2), (32, 256, 256, 512)]
+    for shape in shapes:
+        profile(*shape)

@@ -32,6 +32,7 @@ from milnce_tpu.train.schedule import build_schedule
 from milnce_tpu.train.state import TrainState, build_optimizer, create_train_state
 from milnce_tpu.train.step import make_train_step
 from milnce_tpu.utils.logging import RunLogger
+from milnce_tpu.utils.profiling import StepTimer, maybe_trace
 
 
 def build_source(cfg: Config):
@@ -139,12 +140,24 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
     # there (undefined test_loader, SURVEY.md §2.4); here it works.
     eval_every = max(1, cfg.train.batch_size // 512)
 
+    # The loss stays ON DEVICE in the hot loop: a per-step ``float(loss)``
+    # would block the host on every step's completion and defeat the async
+    # dispatch that device_prefetch exists to enable (the reference has the
+    # same flaw implicitly — loss.item() per batch, main_distributed.py:212).
+    # Host transfer happens only every ``n_display`` steps and at exit.
     total_steps = 0
-    last_loss = float("nan")
-    running = 0.0
+    last_loss_dev = None
+    running_dev = None
     window = 0
+    timer = StepTimer(clips_per_step=cfg.train.batch_size)
     tick = time.time()
+
+    def fetch(dev_val) -> float:
+        return (float(jax.device_get(dev_val))
+                if dev_val is not None else float("nan"))
+
     try:
+      with maybe_trace(cfg.train.trace_dir or None):
         for epoch in range(start_epoch, cfg.optim.epochs):
             if (cfg.train.evaluate and cfg.data.eval_video_root
                     and epoch % eval_every == 0):
@@ -157,8 +170,10 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                 state, loss = step_fn(state, video, text, start)
                 total_steps += 1
                 window += 1
-                running += float(loss)
-                last_loss = float(loss)
+                timer.tick()
+                # async device-side accumulation — no host sync here
+                running_dev = loss if running_dev is None else running_dev + loss
+                last_loss_dev = loss
                 if window % cfg.train.n_display == 0:
                     # LR + progress from the RESTORED step counter, so they
                     # stay correct across resumes.
@@ -169,9 +184,12 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                         f"Epoch {epoch + 1}, Elapsed Time: "
                         f"{time.time() - tick:.3f}, Epoch status: "
                         f"{progress:.4f}, Training loss: "
-                        f"{running / window:.4f}, Learning rate: {lr:.6f}")
-                    running = 0.0
+                        f"{fetch(running_dev) / window:.4f}, "
+                        f"Learning rate: {lr:.6f}, Throughput: "
+                        f"{timer.clips_per_sec:.1f} clips/s")
+                    running_dev = None
                     window = 0
+                    timer.reset()
                     tick = time.time()
                 if preempted["flag"] or (max_steps is not None
                                          and total_steps >= max_steps):
@@ -182,10 +200,11 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                     # silently skip the epoch's remaining batches)
                     manager.save(epoch, state)
                     manager.wait()
-                    return TrainResult(state, total_steps, last_loss)
+                    return TrainResult(state, total_steps,
+                                       fetch(last_loss_dev))
             manager.save(epoch + 1, state)
     finally:
         manager.wait()
         if prev_handler is not None:
             signal.signal(signal.SIGTERM, prev_handler)
-    return TrainResult(state, total_steps, last_loss)
+    return TrainResult(state, total_steps, fetch(last_loss_dev))

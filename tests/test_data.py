@@ -119,6 +119,73 @@ def test_howto100m_source(howto_dir):
     assert s["text"].shape == (3, c.max_words)
 
 
+class FlakyDecoder(FakeDecoder):
+    """Raises on a deterministic subset of paths (simulated corrupt files —
+    HowTo100M at scale has thousands; VERDICT r1 #5 / SURVEY §7 hard 2).
+    Selection is by the digits in the filename (vid0, vid1, ...), never by
+    hash() — which is per-process-randomized and would make tests flaky."""
+
+    def __init__(self, bad_every: int = 0, **kw):
+        super().__init__(**kw)
+        self.bad_every = bad_every
+        self.failures = 0
+
+    def decode(self, path, *a, **kw):
+        num = int("".join(c for c in path if c.isdigit()) or 0)
+        if self.bad_every and num % self.bad_every == 0:
+            self.failures += 1
+            raise RuntimeError(f"simulated corrupt video: {path}")
+        return super().decode(path, *a, **kw)
+
+
+def _howto_source(howto_dir, decoder, n_candidates=3):
+    from milnce_tpu.data.datasets import HowTo100MSource
+
+    cfg = tiny_preset()
+    cfg.data.train_csv = str(howto_dir / "train.csv")
+    cfg.data.video_root = str(howto_dir / "videos")
+    cfg.data.caption_root = str(howto_dir / "captions")
+    cfg.data.num_candidates = n_candidates
+    tok = Tokenizer([f"word{i}" for i in range(1, 8)], cfg.data.max_words)
+    return cfg, HowTo100MSource(cfg.data, cfg.model, decoder=decoder,
+                                tokenizer=tok)
+
+
+def test_bad_videos_resampled_full_batches(howto_dir):
+    """A decoder failing on a subset of paths must not kill the epoch:
+    the source resamples and every batch comes out full."""
+    from milnce_tpu.data.pipeline import ShardedLoader
+
+    dec = FlakyDecoder(bad_every=3)  # ~1/3 of paths raise
+    cfg, src = _howto_source(howto_dir, dec)
+    loader = ShardedLoader(src, global_batch_size=2, seed=0, num_threads=2,
+                           process_index=0, process_count=1)
+    batches = list(loader.epoch(0))
+    assert len(batches) == 2
+    for b in batches:
+        assert b["video"].shape[0] == 2
+        assert b["text"].shape == (2, 3, cfg.data.max_words)
+    assert dec.failures > 0              # the flaky paths were actually hit
+    assert src.decode_failures == dec.failures
+
+
+def test_all_bad_videos_black_frame_fallback(howto_dir):
+    """Every path failing: bounded retries, then a black-frame sample
+    (never an exception, never a stalled step)."""
+
+    class AlwaysBad(FakeDecoder):
+        def decode(self, *a, **kw):
+            raise RuntimeError("all videos corrupt")
+
+    cfg, src = _howto_source(howto_dir, AlwaysBad())
+    s = src.sample(0, np.random.RandomState(0))
+    c = cfg.data
+    assert s["video"].shape == (c.num_frames, c.video_size, c.video_size, 3)
+    assert s["video"].sum() == 0
+    assert s["text"].shape == (3, c.max_words) and s["text"].dtype == np.int32
+    assert src.decode_failures == src.MAX_RETRIES + 1
+
+
 def test_hmdb_label_stripping():
     from milnce_tpu.data.datasets import HMDBSource
 

@@ -1,0 +1,225 @@
+"""DTW loss family pinned to the REFERENCE math (loss.py:20-134) with
+numpy transcriptions — the same way test_milnce.py pins MIL-NCE to
+loss.py:6-18.
+
+Each golden below is a line-by-line float64 numpy transcription of the
+reference formulas (soft-DTW DP: soft_dtw_cuda.py:186-207; dist funcs:
+:325-363; loss compositions: loss.py:20-134), evaluated at the
+reference's hardcoded shapes where it has them (world size 8 for CDTW's
+``repeat(8,...)``, B=160/n=8/stride-1288 for SDTW_negative).  Deliberate
+deviations are tested explicitly and documented inline.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from milnce_tpu.losses.dtw_losses import (cdtw_batch_loss, cdtw_loss,
+                                          sdtw_3_loss, sdtw_cidm_loss,
+                                          sdtw_negative_loss)
+
+
+# ------------------------------------------------------------ transcriptions
+def np_softdtw(D, gamma):
+    """compute_softdtw, soft_dtw_cuda.py:186-207 (float64, inf borders)."""
+    B, N, M = D.shape
+    R = np.full((B, N + 2, M + 2), np.inf)
+    R[:, 0, 0] = 0.0
+    for b in range(B):
+        for j in range(1, M + 1):
+            for i in range(1, N + 1):
+                r0 = -R[b, i - 1, j - 1] / gamma
+                r1 = -R[b, i - 1, j] / gamma
+                r2 = -R[b, i, j - 1] / gamma
+                rmax = max(r0, r1, r2)
+                rsum = (np.exp(r0 - rmax) + np.exp(r1 - rmax)
+                        + np.exp(r2 - rmax))
+                softmin = -gamma * (np.log(rsum) + rmax)
+                R[b, i, j] = D[b, i - 1, j - 1] + softmin
+    return R[:, -2, -2]
+
+
+def np_cosine_cost(x, y, eps=1e-8):
+    """exp(1 - cosine_similarity) (soft_dtw_cuda.py:337-348; torch
+    cosine_similarity clamps the norm product at eps)."""
+    num = np.einsum("bnd,bmd->bnm", x, y)
+    nx = np.linalg.norm(x, axis=-1)[:, :, None]
+    ny = np.linalg.norm(y, axis=-1)[:, None, :]
+    return np.exp(1.0 - num / np.maximum(nx * ny, eps))
+
+
+def np_negative_dot_cost(x, y):
+    """-<x, y> (soft_dtw_cuda.py:350-363)."""
+    return -np.einsum("bnd,bmd->bnm", x, y)
+
+
+def np_sdtw_cosine(x, y, gamma):
+    return np_softdtw(np_cosine_cost(x, y), gamma)
+
+
+def logsumexp(v, axis=None):
+    mx = np.max(v, axis=axis, keepdims=True)
+    out = np.log(np.sum(np.exp(v - mx), axis=axis, keepdims=True)) + mx
+    return np.squeeze(out, axis=axis) if axis is not None else out.item()
+
+
+def _seqs(b, n, m, d, seed=0, scale=1.0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(b, n, d).astype(np.float32) * scale,
+            rng.randn(b, m, d).astype(np.float32) * scale)
+
+
+# ------------------------------------------------------------------- CDTW
+class TestCDTWGolden:
+    """Reference CDTW (loss.py:20-32): gamma=1e-5 cosine soft-DTW;
+    pos = own-pair score of the ``args.rank``-th sample; neg = that
+    sample's video against every text (the hardcoded ``repeat(8,...)`` =
+    world size 8); loss = pos - logsumexp(neg)."""
+
+    GAMMA = 1e-5
+    B = 8  # the reference's hardcoded world size
+
+    def golden(self, v, t, rank):
+        pos = np_sdtw_cosine(v[rank:rank + 1], t[rank:rank + 1], self.GAMMA)
+        neg = np_sdtw_cosine(np.broadcast_to(v[rank], (self.B,) + v[rank].shape),
+                             t, self.GAMMA)
+        return pos[0] - logsumexp(neg)
+
+    @pytest.mark.parametrize("rank", [0, 3, 7])
+    def test_anchor_loss_matches_reference(self, rank):
+        v, t = _seqs(self.B, 5, 5, 6, seed=rank)
+        ours = float(cdtw_loss(jnp.asarray(v), jnp.asarray(t), index=rank,
+                               gamma=self.GAMMA)[0])
+        np.testing.assert_allclose(ours, self.golden(v, t, rank), rtol=2e-4)
+
+    def test_batch_loss_is_mean_over_anchors(self):
+        """Documented deviation: our batch-generic form averages the
+        reference's per-rank loss over every anchor (identical in
+        expectation over ranks — VERDICT r1 / dtw_losses.py:20-29)."""
+        v, t = _seqs(self.B, 5, 5, 6, seed=11)
+        want = np.mean([self.golden(v, t, r) for r in range(self.B)])
+        ours = float(cdtw_batch_loss(jnp.asarray(v), jnp.asarray(t),
+                                     gamma=self.GAMMA))
+        np.testing.assert_allclose(ours, want, rtol=2e-4)
+
+
+# ---------------------------------------------------------------- SDTW_CIDM
+class TestSDTWCIDMGolden:
+    """Reference SDTW_CIDM (loss.py:34-68), gamma=0.1, sigma=10, lam=1.
+
+    The reference's attract/repel terms multiply a (B,B) interval mask
+    into a (B,n,n) per-sample FRAME-distance tensor — it only broadcasts
+    when B == n and then mixes sample indices with frame indices
+    (VERDICT r1 weak #8; SURVEY §2.4).  Our cleaned form defines the
+    pair distance on frame-MEAN embeddings, a (B,B) object matching the
+    (B,B) mask.  The building blocks shared with the reference (interval
+    mask y/w_/w, the soft-DTW term) are pinned to the reference formulas
+    exactly; the cleaned I_x/I_y composition is pinned to its own
+    documented formula so the semantics cannot drift.
+    """
+
+    GAMMA, SIGMA, LAM = 0.1, 10.0, 1.0
+
+    def test_matches_transcription(self):
+        b, n, d = 4, 6, 5
+        v, t = _seqs(b, n, n, d, seed=3)
+        start = np.array([0.0, 4.0, 25.0, 40.0], np.float32)
+
+        # reference loss.py:59-62: y, w_, w from pairwise |start_i-start_j|
+        dist = np.abs(start[:, None] - start[None, :])
+        y = (dist > self.SIGMA).astype(np.float64)
+        w_ = dist + 1.0
+        w = 1.0 / w_
+        # cleaned pair distance: cosine dist between frame-mean embeddings
+        vm, tm = v.mean(1), t.mean(1)
+
+        # raw 1-cos distance (loss.py:40-47 — unlike the soft-DTW cost,
+        # the CIDM distance is NOT exponentiated)
+        def cos_dist(a):
+            num = a @ a.T
+            nrm = np.linalg.norm(a, axis=-1)
+            return 1.0 - num / np.maximum(nrm[:, None] * nrm[None, :], 1e-8)
+
+        d_x = cos_dist(vm)
+        d_y = cos_dist(tm)
+        i_x = (y * w_ * np.maximum(self.LAM - d_x, 0.0)
+               + (1 - y) * w * d_x).sum(1)
+        i_y = (y * w_ * np.maximum(self.LAM - d_y, 0.0)
+               + (1 - y) * w * d_y).sum(1)
+        # soft-DTW term exactly as the reference (loss.py:67: cosine, 0.1)
+        dtw = np_sdtw_cosine(v, t, self.GAMMA)
+        want = np.mean(i_x + i_y + dtw)
+
+        ours = float(sdtw_cidm_loss(jnp.asarray(v), jnp.asarray(t),
+                                    jnp.asarray(start), gamma=self.GAMMA,
+                                    sigma=self.SIGMA, lam=self.LAM))
+        np.testing.assert_allclose(ours, want, rtol=1e-4)
+
+    def test_reference_broadcast_requires_b_equals_n(self):
+        """Document the defect motivating the deviation: the reference's
+        (B,B) mask times (B,n,n) frame distances only broadcasts when
+        B == n (loss.py:59-66)."""
+        b, n = 4, 6
+        mask = np.zeros((b, b))
+        frame_dist = np.zeros((b, n, n))
+        with pytest.raises(ValueError):
+            np.broadcast_arrays(mask, frame_dist)
+
+
+# ------------------------------------------------------------ SDTW_negative
+class TestSDTWNegativeGolden:
+    """Reference SDTW_negative (loss.py:70-91) at its HARDCODED shapes:
+    B=160 clips x n=8 frames; the chunk/cat/mask-stride-1288 dance zeroes
+    each clip's own 8x8 block of the (1280,1280) frame-pair matrix."""
+
+    GAMMA = 0.1
+    B, N = 160, 8
+
+    def test_matches_chunk_mask_transcription(self):
+        d = 16
+        v, t = _seqs(self.B, self.N, self.N, d, seed=5, scale=0.3)
+
+        # loss.py:80-88, literally:
+        pairwise = v.reshape(-1, d).astype(np.float64) @ t.reshape(-1, d).T
+        chunks = np.split(pairwise, self.B, axis=0)          # 160 x (8, 1280)
+        cat = np.concatenate(chunks, axis=1)                 # (8, 204800)
+        mask = [1288 * i + j for i in range(self.B) for j in range(self.N)]
+        cat[:, mask] = 0.0
+        back = np.concatenate(np.split(cat, self.B, axis=1), axis=0)
+        negative = np.exp(back).sum(1).reshape(self.B, self.N).sum(1)
+
+        sdtw = np_sdtw_cosine(v, t, self.GAMMA)
+        want = np.mean(sdtw + negative / (self.B - 1))       # loss.py:90
+
+        ours = float(sdtw_negative_loss(jnp.asarray(v), jnp.asarray(t),
+                                        gamma=self.GAMMA))
+        np.testing.assert_allclose(ours, want, rtol=1e-4)
+
+
+# ----------------------------------------------------------------- SDTW_3
+class TestSDTW3Golden:
+    """Reference SDTW_3 (loss.py:93-134): three NCE-over-soft-DTW terms
+    with negative_dot distance, gamma=0.1; neg[i,j] = -sdtw(x_j, y_i),
+    logsumexp over j."""
+
+    GAMMA = 0.1
+
+    def nce(self, x, y):
+        pos = -np_softdtw(np_negative_dot_cost(x, y), self.GAMMA)
+        b = x.shape[0]
+        neg = np.empty((b, b))
+        for i in range(b):
+            for j in range(b):
+                neg[i, j] = -np_softdtw(
+                    np_negative_dot_cost(x[j:j + 1], y[i:i + 1]),
+                    self.GAMMA)[0]
+        return np.mean(logsumexp(neg, axis=1) - pos)
+
+    def test_all_three_terms_match(self):
+        b, n, d = 3, 4, 5
+        v, t = _seqs(b, n, n, d, seed=9, scale=0.5)
+        want = (self.nce(v, v), self.nce(v, t), self.nce(t, t))
+        ours = sdtw_3_loss(jnp.asarray(v), jnp.asarray(t), gamma=self.GAMMA)
+        for o, w in zip(ours, want):
+            np.testing.assert_allclose(float(o), w, rtol=2e-4)

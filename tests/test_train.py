@@ -29,6 +29,31 @@ def test_training_runs_and_loss_is_finite(tiny_cfg):
     assert np.isfinite(result.last_loss)
 
 
+def test_no_per_step_host_sync(tiny_cfg, tmp_path, monkeypatch):
+    """The hot loop must not block the host on every step (VERDICT r1 #7):
+    loss transfers happen only at display points / exit, via
+    jax.device_get — count them over a 4-step run with n_display=2."""
+    import jax
+
+    import milnce_tpu.train.loop as loop_mod
+
+    calls = {"n": 0}
+    real = jax.device_get
+
+    def counting(x):
+        calls["n"] += 1
+        return real(x)
+
+    monkeypatch.setattr(loop_mod.jax, "device_get", counting)
+    cfg = tiny_cfg
+    cfg.train.checkpoint_root = str(tmp_path / "ckpt_sync")
+    cfg.train.n_display = 2
+    result = loop_mod.run_training(cfg, max_steps=4)
+    assert result.steps == 4
+    # 2 display fetches + 1 exit fetch; a per-step sync would be >= 4
+    assert calls["n"] <= 3, f"host synced {calls['n']} times in 4 steps"
+
+
 def test_checkpoint_resume_roundtrip(tiny_cfg, tmp_path):
     import jax
 
