@@ -34,6 +34,8 @@ class DataConfig:
     max_words: int = 20
     num_candidates: int = 5             # MIL candidate captions per clip
     num_reader_threads: int = 20        # host-side decode workers per process
+    use_native_reader: bool = False     # C++ ReaderPool pipe pump for ffmpeg
+                                        # decode (native/milnce_native.cpp)
     prefetch_depth: int = 2             # device prefetch buffer (batches)
     synthetic: bool = False             # hermetic in-memory source (no ffmpeg)
     synthetic_num_samples: int = 256

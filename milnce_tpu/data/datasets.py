@@ -75,7 +75,14 @@ class HowTo100MSource:
         self.cfg = cfg
         self.rows = read_csv(cfg.train_csv)
         assert self.rows and "video_path" in self.rows[0], cfg.train_csv
-        self.decoder = decoder or FFmpegDecoder()
+        if decoder is None:
+            if cfg.use_native_reader:
+                from milnce_tpu.data.video import NativeFFmpegDecoder
+
+                decoder = NativeFFmpegDecoder(workers=cfg.num_reader_threads)
+            else:
+                decoder = FFmpegDecoder()
+        self.decoder = decoder
         self.tokenizer = tokenizer or build_tokenizer(model_cfg, cfg.max_words)
         self._caption_cache: "OrderedDict[str, CaptionTrack]" = OrderedDict()
         self._cache_lock = threading.Lock()

@@ -57,6 +57,19 @@ class FFmpegDecoder:
     def available(self) -> bool:
         return shutil.which(self.binary) is not None
 
+    def command(self, path: str, start_seek: float, num_sec: float,
+                fps: int, size: int, aw: float = 0.5, ah: float = 0.5,
+                crop_only: bool = False, hflip: bool = False) -> list[str]:
+        """The decode argv (rawvideo rgb24 on stdout) — shared by the
+        subprocess path below and the native ReaderPool path."""
+        vf = f"fps={fps},{_crop_expr(size, aw, ah, crop_only)}"
+        if hflip:
+            vf += ",hflip"
+        return [self.binary, "-nostdin", "-loglevel", "error",
+                "-ss", f"{start_seek}", "-t", f"{num_sec + 0.1}",
+                "-i", path, "-vf", vf,
+                "-f", "rawvideo", "-pix_fmt", "rgb24", "pipe:"]
+
     def decode(self, path: str, start_seek: float, num_sec: float,
                fps: int, size: int, aw: float = 0.5, ah: float = 0.5,
                crop_only: bool = False, hflip: bool = False) -> np.ndarray:
@@ -64,12 +77,8 @@ class FFmpegDecoder:
             raise RuntimeError(
                 "ffmpeg binary not found — install it on the host or use the "
                 "synthetic data source (data.synthetic=True)")
-        vf = f"fps={fps},{_crop_expr(size, aw, ah, crop_only)}"
-        if hflip:
-            vf += ",hflip"
-        cmd = [self.binary, "-nostdin", "-ss", f"{start_seek}",
-               "-t", f"{num_sec + 0.1}", "-i", path, "-vf", vf,
-               "-f", "rawvideo", "-pix_fmt", "rgb24", "pipe:"]
+        cmd = self.command(path, start_seek, num_sec, fps, size, aw, ah,
+                           crop_only, hflip)
         out = subprocess.run(cmd, stdout=subprocess.PIPE,
                              stderr=subprocess.DEVNULL, check=True).stdout
         n = len(out) // (size * size * 3)
@@ -84,6 +93,62 @@ class FFmpegDecoder:
                "default=noprint_wrappers=1:nokey=1", path]
         return float(subprocess.run(cmd, stdout=subprocess.PIPE,
                                     check=True).stdout.strip())
+
+
+class NativeFFmpegDecoder(FFmpegDecoder):
+    """FFmpegDecoder whose byte pumping runs in the C++ ReaderPool
+    (native/milnce_native.cpp): worker threads popen() the decode command
+    and fread() rawvideo straight into a caller-owned numpy buffer — no
+    GIL, no Python-side byte copies.  Enable with
+    ``DataConfig.use_native_reader``.
+
+    The pool is shared across the loader's Python threads; each decode()
+    submits one job and blocks only its own thread (reader_wait drops the
+    GIL inside ctypes), so ``workers`` C++ threads pump pipes while
+    Python threads do tokenization etc.
+
+    A decode whose output exactly fills the buffer is treated as
+    truncated (raise) rather than silently cropped; the buffer is sized
+    with slack frames so a correct decode never hits that.
+    """
+
+    SLACK_FRAMES = 4
+
+    def __init__(self, binary: str = "ffmpeg", probe_binary: str = "ffprobe",
+                 workers: int = 8):
+        super().__init__(binary=binary, probe_binary=probe_binary)
+        from milnce_tpu.native.reader import ReaderPool
+
+        self._pool = ReaderPool(workers=workers)
+
+    def decode(self, path: str, start_seek: float, num_sec: float,
+               fps: int, size: int, aw: float = 0.5, ah: float = 0.5,
+               crop_only: bool = False, hflip: bool = False) -> np.ndarray:
+        if not self.available():
+            raise RuntimeError(
+                "ffmpeg binary not found — install it on the host or use the "
+                "synthetic data source (data.synthetic=True)")
+        import shlex
+
+        cmd = self.command(path, start_seek, num_sec, fps, size, aw, ah,
+                           crop_only, hflip)
+        # the pool popen()s through /bin/sh with inherited fds: route any
+        # remaining decoder chatter away from the training logs (the
+        # subprocess path gets the same via stderr=DEVNULL)
+        cmd_str = " ".join(shlex.quote(c) for c in cmd) + " 2>/dev/null"
+        frame_bytes = size * size * 3
+        max_frames = int(np.ceil((num_sec + 0.1) * fps)) + self.SLACK_FRAMES
+        buf = np.empty((max_frames * frame_bytes,), np.uint8)
+        got = self._pool.decode_into([cmd_str], [buf])[0]
+        if got < 0:
+            raise RuntimeError(f"native decode spawn failed: {path}")
+        if got == 0:
+            raise RuntimeError(f"native decode produced no frames: {path}")
+        if got >= buf.nbytes:
+            raise RuntimeError(f"native decode overflow (buffer too small "
+                               f"for {path}; got >= {buf.nbytes} bytes)")
+        n = got // frame_bytes
+        return buf[: n * frame_bytes].reshape(n, size, size, 3).copy()
 
 
 @dataclass

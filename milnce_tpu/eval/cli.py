@@ -86,6 +86,15 @@ def main(argv=None):
     p.add_argument("--space_to_depth", action="store_true",
                    help="upstream flat checkpoints need this")
     p.add_argument("--max_words", type=int, default=30)
+    # model-shape overrides (hermetic smoke runs / ablations)
+    p.add_argument("--embedding_dim", type=int, default=None)
+    p.add_argument("--inception_blocks", type=int, default=None)
+    p.add_argument("--word_embedding_dim", type=int, default=None)
+    p.add_argument("--text_hidden_dim", type=int, default=None)
+    p.add_argument("--vocab_size", type=int, default=None)
+    p.add_argument("--fake_decoder", action="store_true",
+                   help="deterministic in-memory decoder (no ffmpeg/videos); "
+                        "hermetic CLI smoke only")
     args = p.parse_args(argv)
 
     data_cfg = DataConfig(fps=args.fps, num_frames=args.num_frames,
@@ -93,6 +102,15 @@ def main(argv=None):
     model_cfg = ModelConfig(space_to_depth=args.space_to_depth,
                             token_dict_path=args.token_dict,
                             word2vec_path=args.word2vec)
+    for fld in ("embedding_dim", "inception_blocks", "word_embedding_dim",
+                "text_hidden_dim", "vocab_size"):
+        if getattr(args, fld) is not None:
+            setattr(model_cfg, fld, getattr(args, fld))
+    decoder = None
+    if args.fake_decoder:
+        from milnce_tpu.data.video import FakeDecoder
+
+        decoder = FakeDecoder()
     model = build_model(model_cfg)
     mesh = build_mesh(ParallelConfig())
 
@@ -101,10 +119,16 @@ def main(argv=None):
                          args.video_size, 3), jnp.float32),
               jnp.zeros((1, args.max_words), jnp.int32))
     variables = load_variables(args.ckpt, model, model_cfg, sample)
+    # Orbax-restored arrays are committed to one device; replicate over the
+    # mesh so they compose with the shard_map'ed embed fns (same fix as the
+    # train-resume path, train/loop.py).
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    variables = jax.device_put(variables, NamedSharding(mesh, P()))
 
     if args.task == "hmdb":
         source = HMDBSource(args.csv, args.video_root, data_cfg,
-                            num_clip=args.num_windows)
+                            num_clip=args.num_windows, decoder=decoder)
         accs = evaluate_linear_probe(model, variables, source, mesh)
         for k, v in accs.items():
             print(f"HMDB top-1 {k}: {v:.4f}")
@@ -113,7 +137,8 @@ def main(argv=None):
     tokenizer = build_tokenizer(model_cfg, args.max_words)
     cls = YouCookSource if args.task == "youcook" else MSRVTTSource
     source = cls(args.csv, args.video_root, data_cfg, tokenizer,
-                 num_clip=args.num_windows, max_words=args.max_words)
+                 num_clip=args.num_windows, max_words=args.max_words,
+                 decoder=decoder)
     metrics = evaluate_retrieval(model, variables, source, mesh,
                                  batch_size=args.batch_size)
     print(format_metrics(metrics))

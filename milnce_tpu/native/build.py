@@ -73,11 +73,11 @@ def _declare(lib: ctypes.CDLL) -> None:
     f32p = ctypes.POINTER(ctypes.c_float)
     lib.reader_create.restype = ctypes.c_void_p
     lib.reader_create.argtypes = [ctypes.c_int]
-    lib.reader_submit.restype = ctypes.c_int
+    lib.reader_submit.restype = ctypes.c_long
     lib.reader_submit.argtypes = [ctypes.c_void_p, ctypes.c_char_p, u8p,
                                   ctypes.c_long]
     lib.reader_wait.restype = ctypes.c_long
-    lib.reader_wait.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.reader_wait.argtypes = [ctypes.c_void_p, ctypes.c_long]
     lib.reader_destroy.restype = None
     lib.reader_destroy.argtypes = [ctypes.c_void_p]
     lib.softdtw_forward_cpu.restype = None

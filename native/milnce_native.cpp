@@ -27,6 +27,7 @@
 #include <mutex>
 #include <string>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 // ---------------------------------------------------------------- reader
@@ -41,10 +42,16 @@ struct Job {
   bool done = false;
 };
 
+// Jobs live in an id-keyed map: node-based, so concurrent reader_submit
+// calls never invalidate a Job reference a worker holds mid-fread (a
+// growable vector would), and reader_wait erases its entry so a
+// long-lived pool — one per training run, ~1.2M decodes/epoch — holds
+// O(in-flight) jobs, not O(all-ever-submitted).
 struct Pool {
   std::vector<std::thread> workers;
-  std::deque<int> queue;
-  std::vector<Job> jobs;
+  std::deque<long> queue;
+  std::unordered_map<long, Job> jobs;
+  long next_id = 0;
   std::mutex mu;
   std::condition_variable cv_work, cv_done;
   bool stopping = false;
@@ -57,18 +64,15 @@ struct Pool {
 
   void run() {
     for (;;) {
-      int id;
+      long id;
+      Job* j;
       {
         std::unique_lock<std::mutex> lk(mu);
         cv_work.wait(lk, [this] { return stopping || !queue.empty(); });
         if (stopping && queue.empty()) return;
         id = queue.front();
         queue.pop_front();
-      }
-      Job* j;
-      {
-        std::lock_guard<std::mutex> lk(mu);
-        j = &jobs[id];
+        j = &jobs.at(id);  // reference stable: node-based container
       }
       long total = 0;
       FILE* p = popen(j->cmd.c_str(), "r");
@@ -89,8 +93,8 @@ struct Pool {
       }
       {
         std::lock_guard<std::mutex> lk(mu);
-        jobs[id].bytes_read = total;
-        jobs[id].done = true;
+        j->bytes_read = total;
+        j->done = true;
       }
       cv_done.notify_all();
     }
@@ -112,24 +116,26 @@ extern "C" {
 
 void* reader_create(int workers) { return new Pool(std::max(1, workers)); }
 
-int reader_submit(void* pool, const char* cmd, uint8_t* buf, long capacity) {
+long reader_submit(void* pool, const char* cmd, uint8_t* buf, long capacity) {
   auto* p = static_cast<Pool*>(pool);
-  int id;
+  long id;
   {
     std::lock_guard<std::mutex> lk(p->mu);
-    id = static_cast<int>(p->jobs.size());
-    p->jobs.push_back(Job{cmd, buf, capacity});
+    id = p->next_id++;
+    p->jobs.emplace(id, Job{cmd, buf, capacity});
     p->queue.push_back(id);
   }
   p->cv_work.notify_one();
   return id;
 }
 
-long reader_wait(void* pool, int id) {
+long reader_wait(void* pool, long id) {
   auto* p = static_cast<Pool*>(pool);
   std::unique_lock<std::mutex> lk(p->mu);
-  p->cv_done.wait(lk, [p, id] { return p->jobs[id].done; });
-  return p->jobs[id].bytes_read;
+  p->cv_done.wait(lk, [p, id] { return p->jobs.at(id).done; });
+  long bytes = p->jobs.at(id).bytes_read;
+  p->jobs.erase(id);  // bounded memory for long-lived pools
+  return bytes;
 }
 
 void reader_destroy(void* pool) { delete static_cast<Pool*>(pool); }

