@@ -64,6 +64,29 @@ def _peak_flops(device_kind: str):
     return None
 
 
+def _probe_backend(timeout_s: float = 180.0) -> bool:
+    """Initialize the accelerator backend in a THROWAWAY subprocess first.
+
+    Two observed failure modes of the TPU tunnel make in-process init
+    unsafe: it can raise UNAVAILABLE (the round-1 bench crash), and it
+    can HANG indefinitely (observed when a previous client died
+    mid-connect) — a hang in the main process would eat the driver's
+    whole gate timeout with no JSON emitted.  A subprocess probe converts
+    both into a clean boolean."""
+    code = "import jax; print(len(jax.devices()))"
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, timeout=timeout_s)
+        ok = proc.returncode == 0
+        if not ok:
+            _note(f"bench: backend probe rc={proc.returncode}: "
+                  f"{proc.stderr.decode()[-300:]}")
+        return ok
+    except subprocess.TimeoutExpired:
+        _note(f"bench: backend probe hung >{timeout_s}s — falling back")
+        return False
+
+
 def _devices():
     """jax.devices(), or raise. No in-process retry: jax caches a failed
     backend init, so a second call in this process can only re-raise —
@@ -269,17 +292,8 @@ def main():
         except Exception:
             pass
 
-        if os.environ.get(_CPU_CHILD_FLAG) == "1":
-            jax.config.update("jax_platforms", "cpu")
-
-        try:
-            devices = _devices()
-        except Exception as exc:
-            if os.environ.get(_CPU_CHILD_FLAG) == "1":
-                raise
-            # Backend dead in this process (failed TPU init is cached by
-            # jax) — re-exec on CPU so the driver still gets a real number.
-            _note(f"bench: backend init failed ({exc}); re-exec on CPU")
+        def run_cpu_child():
+            _note("bench: accelerator unavailable; re-exec on CPU")
             env = dict(os.environ)
             env[_CPU_CHILD_FLAG] = "1"
             env["JAX_PLATFORMS"] = "cpu"
@@ -287,6 +301,26 @@ def main():
                                   env=env, cwd=_REPO)
             if proc.returncode != 0:
                 raise RuntimeError(f"CPU fallback child rc={proc.returncode}")
+
+        if os.environ.get(_CPU_CHILD_FLAG) == "1":
+            jax.config.update("jax_platforms", "cpu")
+        elif not _probe_backend():
+            # Accelerator init would crash or HANG this process — run the
+            # whole bench on CPU in a child so the driver still gets its
+            # JSON line.  (The probe costs one duplicate backend init on
+            # the healthy path — accepted: it is the only guard against
+            # the hang mode, which no in-process try/except can catch.)
+            run_cpu_child()
+            return
+
+        try:
+            devices = _devices()
+        except Exception:
+            # Probe succeeded but the tunnel flaked between probe and real
+            # init (UNAVAILABLE is intermittent) — still recover on CPU.
+            if os.environ.get(_CPU_CHILD_FLAG) == "1":
+                raise
+            run_cpu_child()
             return
 
         on_tpu = any(d.platform in ("tpu", "axon") for d in devices)

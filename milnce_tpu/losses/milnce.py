@@ -17,6 +17,15 @@ chip after an NCCL all-gather, each shard gathers embeddings over the mesh
 axis (one XLA collective over ICI) but scores only its LOCAL rows and
 columns — per-chip memory O(B_local * B_global * K) — then psum-reduces.
 This is mathematically identical to the reference's replicated loss.
+
+Memory bound at the baseline scale (Bg=8192, K=5, 64 chips -> B_local=128):
+two (B_local, Bg, K) f32 cubes = 2 x 128*8192*5*4 B ~ 42 MB per chip
+(the replicated reference form would need ~1.3 GB per GPU for x plus its
+transpose concat, loss.py:16).  The denominator combines two separate
+logsumexp reductions with logaddexp, so no (B, 2*Bg*K) concat is ever
+materialized; tests/test_milnce.py pins the compiled per-chip temp size
+at Bg=8192.  A reduce_scatter formulation could stream the cols cube too,
+but at these scales the gather+local-score form is already HBM-trivial.
 """
 
 from __future__ import annotations
@@ -60,9 +69,13 @@ def milnce_loss(video_embd: jax.Array, text_embd: jax.Array,
 
     diag = rows[jnp.arange(b), offset + jnp.arange(b), :]          # (B, K)
     numerator = jax.nn.logsumexp(diag, axis=1)
-    both = jnp.concatenate(
-        [rows.reshape(b, -1), jnp.swapaxes(cols, 0, 1).reshape(b, -1)], axis=1)
-    denominator = jax.nn.logsumexp(both, axis=1)
+    # lse over row i AND column i of the cube.  Two separate reductions
+    # combined with logaddexp == lse of the concatenation (the reference's
+    # ``cat((x, x^T), dim=1)``), without materializing a (B, 2*Bg*K) copy —
+    # peak per-chip logits memory stays at the two (B_local, Bg, K) cubes.
+    denominator = jnp.logaddexp(
+        jax.nn.logsumexp(rows.reshape(b, -1), axis=1),
+        jax.nn.logsumexp(jnp.swapaxes(cols, 0, 1).reshape(b, -1), axis=1))
 
     local_sum = jnp.sum(denominator - numerator)
     if axis_name is not None:

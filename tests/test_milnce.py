@@ -101,6 +101,38 @@ def test_sharded_gradients_match_unsharded():
                                atol=1e-6)
 
 
+def test_per_chip_memory_at_baseline_scale():
+    """Compile-only memory proof at the baseline's global batch
+    (SURVEY §7 hard part 4 / VERDICT r1 next #10): at Bg=8192, K=5 on an
+    8-device mesh, the compiled per-chip temp footprint stays at the two
+    local logits cubes O(B_local*Bg*K) — NOT the replicated O(Bg^2*K)
+    cube (which alone would be 8192*8192*5*4 B = 1.3 TB)."""
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices), ("data",))
+    bg, k, d = 8192, 5, 32
+    b_local = bg // len(devices)
+
+    @jax.jit
+    def sharded(v, t):
+        return jax.shard_map(
+            lambda vv, tt: milnce_loss(vv, tt, axis_name="data"),
+            mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P())(v, t)
+
+    v = jax.ShapeDtypeStruct((bg, d), jnp.float32,
+                             sharding=NamedSharding(mesh, P("data")))
+    t = jax.ShapeDtypeStruct((bg * k, d), jnp.float32,
+                             sharding=NamedSharding(mesh, P("data")))
+    with jax.set_mesh(mesh):
+        stats = sharded.lower(v, t).compile().memory_analysis()
+    cube = b_local * bg * k * 4                      # one (B_local, Bg, K) f32
+    # temp budget: rows + cols cubes + reduction scratch; flag anything
+    # beyond 4 cubes (the old concat form needed ~6, replicated needs ~800)
+    assert stats.temp_size_in_bytes <= 4 * cube, (
+        f"per-chip temps {stats.temp_size_in_bytes/1e6:.0f} MB exceed "
+        f"4 cubes ({4*cube/1e6:.0f} MB) — logits memory no longer "
+        f"O(B_local*Bg*K)")
+
+
 def test_scale_invariance_of_batch_position():
     """Permuting batch order permutes nothing about the mean loss."""
     rng = np.random.RandomState(3)
