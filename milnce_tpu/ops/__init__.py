@@ -3,3 +3,4 @@ from milnce_tpu.ops import softdtw_pallas  # noqa: F401  (submodule; its
 # main entry point is softdtw_pallas.softdtw_pallas — re-exporting the
 # function here would shadow the submodule attribute)
 from milnce_tpu.ops.dtw import dtw_loss  # noqa: F401
+from milnce_tpu.ops.softdtw_sp import softdtw_seq_parallel  # noqa: F401

@@ -30,13 +30,20 @@ from jax import lax
 BIG = 1e10  # finite stand-in for +inf: keeps softmin AD NaN-free
 
 
-def skew_cost(D: jax.Array) -> jax.Array:
-    """(B, N, M) cost -> diagonal-major (B, N+M-1, N) with
-    ``out[:, p, i] = D[:, i, p - i]`` (0 where out of range)."""
+def skew_cost(D: jax.Array, n_diags: int | None = None,
+              row_offset=0) -> jax.Array:
+    """(B, N, M) cost -> diagonal-major (B, n_diags, N) with
+    ``out[:, p, i] = D[:, i, p - (row_offset + i)]`` (0 where out of
+    range).  The defaults give the classic full-matrix skew; a nonzero
+    ``row_offset`` (may be traced) skews a row-shard of a larger matrix
+    against GLOBAL diagonal indices — used by the sequence-parallel
+    wavefront (ops/softdtw_sp.py)."""
     _, n, m = D.shape
-    p_idx = jnp.arange(n + m - 1)[:, None]
+    if n_diags is None:
+        n_diags = n + m - 1
+    p_idx = jnp.arange(n_diags)[:, None]
     i_idx = jnp.arange(n)[None, :]
-    j_idx = p_idx - i_idx
+    j_idx = p_idx - (row_offset + i_idx)
     valid = (j_idx >= 0) & (j_idx < m)
     gathered = D[:, i_idx, jnp.clip(j_idx, 0, m - 1)]
     return jnp.where(valid[None], gathered, 0.0)
