@@ -120,6 +120,8 @@ class TrainConfig:
     num_windows_test: int = 4
     verbose: bool = True
     trace_dir: str = ""                 # jax.profiler trace output ('' = off)
+    halt_on_nan: bool = True            # checkpoint + halt when the windowed
+                                        # loss goes non-finite (divergence guard)
 
 
 @dataclass

@@ -12,6 +12,7 @@ flag to pick (the reference's ``--dist-backend nccl``, args.py:46).
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -21,15 +22,44 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from milnce_tpu.config import ParallelConfig
 
 
+def _multihost_tpu_env() -> bool:
+    """True on a multi-host Cloud TPU slice: more than one worker in the
+    TPU runtime's worker list means this process must join a
+    jax.distributed cluster before touching devices.
+
+    The list comes from the env when the TPU env file was sourced, else
+    from the instance metadata — the same two sources JAX's own cluster
+    detection consults (clusters/cloud_tpu_cluster.py), so a process
+    launched from a bare shell on a pod VM is still detected."""
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES")
+    if hosts is None:
+        try:
+            from jax._src.clusters.cloud_tpu_cluster import get_tpu_env_value
+
+            hosts = get_tpu_env_value("WORKER_HOSTNAMES") or ""
+        except Exception:
+            hosts = ""
+    return "," in hosts
+
+
 def initialize_distributed(cfg: ParallelConfig) -> None:
-    """Multi-host process bootstrap.  Single-host (coordinator unset) is a
-    no-op — ``jax.devices()`` already sees every local chip."""
+    """Multi-host process bootstrap.
+
+    - explicit ``coordinator_address``: classic bring-up (any platform);
+    - no address but a multi-host TPU slice detected: bare
+      ``jax.distributed.initialize()`` — coordinator, process count and
+      id all come from the TPU metadata, zero flags (contrast the
+      reference's hand-maintained 10-IP list, train.py:48);
+    - single host: no-op, ``jax.devices()`` already sees every chip.
+    """
     if cfg.coordinator_address:
         jax.distributed.initialize(
             coordinator_address=cfg.coordinator_address,
             num_processes=cfg.num_processes,
             process_id=cfg.process_id,
         )
+    elif _multihost_tpu_env():
+        jax.distributed.initialize()
 
 
 def build_mesh(cfg: ParallelConfig,

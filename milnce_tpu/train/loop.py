@@ -156,6 +156,30 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
         return (float(jax.device_get(dev_val))
                 if dev_val is not None else float("nan"))
 
+    def check_finite(mean_loss: float, epoch: int) -> None:
+        """Divergence guard, evaluated only at display fetches (no extra
+        host syncs): a non-finite windowed loss snapshots the run state
+        for post-mortem and halts instead of burning the rest of the
+        epoch budget on NaNs.
+
+        The snapshot goes to a SEPARATE ``nan_postmortem/`` directory,
+        step-labeled: the rotation manager would both silently refuse the
+        save (Orbax rejects a label <= the last saved one) and — worse —
+        hand the NaN-poisoned params straight back to the next
+        ``--resume``, which restores from the rotation only."""
+        if np.isfinite(mean_loss) or not cfg.train.halt_on_nan:
+            return
+        step_label = int(state.step)
+        pm = CheckpointManager(os.path.join(ckpt_dir, "nan_postmortem"),
+                               keep=1)
+        pm.save(step_label, state)
+        pm.wait()
+        logger.log(f"non-finite training loss ({mean_loss}) — post-mortem "
+                   f"state saved under nan_postmortem/{step_label}; halting")
+        raise FloatingPointError(
+            f"training loss became non-finite ({mean_loss}) at step "
+            f"{step_label}")
+
     try:
       with maybe_trace(cfg.train.trace_dir or None):
         for epoch in range(start_epoch, cfg.optim.epochs):
@@ -180,13 +204,15 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                     opt_step = int(state.step)
                     lr = float(schedule(opt_step))
                     progress = (opt_step % steps_per_epoch) / steps_per_epoch
+                    mean_loss = fetch(running_dev) / window
                     logger.log(
                         f"Epoch {epoch + 1}, Elapsed Time: "
                         f"{time.time() - tick:.3f}, Epoch status: "
                         f"{progress:.4f}, Training loss: "
-                        f"{fetch(running_dev) / window:.4f}, "
+                        f"{mean_loss:.4f}, "
                         f"Learning rate: {lr:.6f}, Throughput: "
                         f"{timer.clips_per_sec:.1f} clips/s")
+                    check_finite(mean_loss, epoch)
                     running_dev = None
                     window = 0
                     timer.reset()

@@ -1,0 +1,101 @@
+"""Asset conversion CLI: the reference's binary blobs -> framework-native
+files.
+
+The reference distributes three external assets (README.md:31-43):
+``word2vec.pth`` (torch-saved (V, 300) embedding table, s3dg.py:159),
+``dict.npy`` (token vocabulary, s3dg.py:152) and S3D checkpoints.
+The library itself never imports torch (models/build.py loads .npy/.npz);
+this CLI does the one-off conversions so a deployment can drop torch
+entirely:
+
+    python -m milnce_tpu.utils.assets word2vec word2vec.pth word2vec.npy
+    python -m milnce_tpu.utils.assets ckpt epoch0012.pth.tar run_dir/
+    python -m milnce_tpu.utils.assets inspect some_checkpoint.pth.tar
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def convert_word2vec(src: str, dst: str) -> tuple[int, int]:
+    """torch-saved embedding table -> .npy; returns (vocab, dim)."""
+    import torch
+
+    table = torch.load(src, map_location="cpu", weights_only=False)
+    if hasattr(table, "weight"):             # nn.Embedding module
+        table = table.weight.detach()
+    arr = np.asarray(table.numpy() if hasattr(table, "numpy") else table,
+                     np.float32)
+    assert arr.ndim == 2, f"expected (V, D) table, got {arr.shape}"
+    np.save(dst, arr)
+    return arr.shape
+
+
+def convert_checkpoint(src: str, dst: str) -> int:
+    """Reference torch checkpoint (either flavor, eval_msrvtt.py:21-32)
+    -> Orbax run directory restorable by train/eval; returns #tensors."""
+    import torch
+
+    from milnce_tpu.utils.torch_convert import torch_state_dict_to_flax
+
+    raw = torch.load(src, map_location="cpu", weights_only=False)
+    sd = raw.get("state_dict", raw)
+    sd = {k: v.numpy() for k, v in sd.items() if hasattr(v, "numpy")}
+    variables = torch_state_dict_to_flax(sd)
+
+    import orbax.checkpoint as ocp
+
+    import os
+    path = os.path.abspath(dst)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.join(path, "variables"), variables)
+    return len(sd)
+
+
+def inspect(src: str) -> None:
+    import torch
+
+    raw = torch.load(src, map_location="cpu", weights_only=False)
+    sd = raw.get("state_dict", raw) if isinstance(raw, dict) else raw
+    if isinstance(sd, dict):
+        print(f"{len(sd)} entries"
+              + (f" (epoch {raw['epoch']})" if isinstance(raw, dict)
+                 and "epoch" in raw else ""))
+        for k, v in list(sd.items())[:40]:
+            shape = tuple(v.shape) if hasattr(v, "shape") else type(v).__name__
+            print(f"  {k}: {shape}")
+        if len(sd) > 40:
+            print(f"  ... {len(sd) - 40} more")
+    else:
+        print(type(sd), getattr(sd, "shape", ""))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="milnce-tpu asset converter")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    w = sub.add_parser("word2vec", help="torch .pth table -> .npy")
+    w.add_argument("src")
+    w.add_argument("dst")
+    c = sub.add_parser("ckpt", help="torch checkpoint -> Orbax dir")
+    c.add_argument("src")
+    c.add_argument("dst")
+    i = sub.add_parser("inspect", help="list a torch checkpoint's tensors")
+    i.add_argument("src")
+    args = p.parse_args(argv)
+
+    if args.cmd == "word2vec":
+        v, d = convert_word2vec(args.src, args.dst)
+        print(f"wrote {args.dst}: ({v}, {d})")
+    elif args.cmd == "ckpt":
+        n = convert_checkpoint(args.src, args.dst)
+        print(f"wrote {args.dst}: {n} tensors")
+    else:
+        inspect(args.src)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
