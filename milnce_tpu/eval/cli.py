@@ -56,18 +56,11 @@ def load_variables(ckpt: str, model, model_cfg: ModelConfig,
         print(f"loaded Orbax checkpoint (epoch {epoch}) from {ckpt}")
         return {"params": state.params, "batch_stats": state.batch_stats}
     # torch formats
-    import torch
+    from milnce_tpu.utils.torch_convert import load_torch_checkpoint_as_flax
 
-    from milnce_tpu.utils.torch_convert import torch_state_dict_to_flax
-
-    raw = torch.load(ckpt, map_location="cpu", weights_only=False)
-    if "state_dict" in raw:
-        sd = raw["state_dict"]
-    else:
-        sd = raw
-    sd = {k: v.numpy() for k, v in sd.items() if hasattr(v, "numpy")}
-    print(f"loaded torch checkpoint with {len(sd)} tensors from {ckpt}")
-    return torch_state_dict_to_flax(sd)
+    variables = load_torch_checkpoint_as_flax(ckpt)
+    print(f"loaded torch checkpoint from {ckpt}")
+    return variables
 
 
 def main(argv=None):

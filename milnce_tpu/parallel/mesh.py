@@ -34,9 +34,18 @@ def _multihost_tpu_env() -> bool:
     hosts = os.environ.get("TPU_WORKER_HOSTNAMES")
     if hosts is None:
         try:
+            # Private jax API (mirrors its GcpTpuCluster): guarded — if it
+            # moves, autodetect degrades to env-only, never crashes.  The
+            # running_in_cloud_tpu_vm gate (libtpu presence) keeps the
+            # metadata HTTP lookup — retried with long timeouts inside
+            # jax — off every non-TPU startup path.
+            from jax._src.cloud_tpu_init import running_in_cloud_tpu_vm
             from jax._src.clusters.cloud_tpu_cluster import get_tpu_env_value
 
-            hosts = get_tpu_env_value("WORKER_HOSTNAMES") or ""
+            if running_in_cloud_tpu_vm:
+                hosts = get_tpu_env_value("WORKER_HOSTNAMES") or ""
+            else:
+                hosts = ""
         except Exception:
             hosts = ""
     return "," in hosts

@@ -89,16 +89,9 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
     variables = model.init(rng, sample_video, sample_text)
     if cfg.train.pretrain_ckpt:
         # converted reference weights (main_distributed.py:81-83)
-        import torch
+        from milnce_tpu.utils.torch_convert import load_torch_checkpoint_as_flax
 
-        from milnce_tpu.utils.torch_convert import torch_state_dict_to_flax
-
-        raw = torch.load(cfg.train.pretrain_ckpt, map_location="cpu",
-                         weights_only=False)
-        sd = raw.get("state_dict", raw)
-        converted = torch_state_dict_to_flax(
-            {k: v.numpy() for k, v in sd.items() if hasattr(v, "numpy")})
-        variables = converted
+        variables = load_torch_checkpoint_as_flax(cfg.train.pretrain_ckpt)
         logger.log(f"loaded pretrained weights from {cfg.train.pretrain_ckpt}")
 
     schedule = build_schedule(cfg.optim, steps_per_epoch)
@@ -156,7 +149,7 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
         return (float(jax.device_get(dev_val))
                 if dev_val is not None else float("nan"))
 
-    def check_finite(mean_loss: float, epoch: int) -> None:
+    def check_finite(mean_loss: float) -> None:
         """Divergence guard, evaluated only at display fetches (no extra
         host syncs): a non-finite windowed loss snapshots the run state
         for post-mortem and halts instead of burning the rest of the
@@ -212,7 +205,7 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                         f"{mean_loss:.4f}, "
                         f"Learning rate: {lr:.6f}, Throughput: "
                         f"{timer.clips_per_sec:.1f} clips/s")
-                    check_finite(mean_loss, epoch)
+                    check_finite(mean_loss)
                     running_dev = None
                     window = 0
                     timer.reset()

@@ -37,23 +37,27 @@ def convert_word2vec(src: str, dst: str) -> tuple[int, int]:
 
 def convert_checkpoint(src: str, dst: str) -> int:
     """Reference torch checkpoint (either flavor, eval_msrvtt.py:21-32)
-    -> Orbax run directory restorable by train/eval; returns #tensors."""
+    -> an Orbax RUN directory in exactly the layout train ``--resume``
+    and the eval CLI restore (CheckpointManager step dirs holding a full
+    TrainState — optimizer state freshly initialized, matching the
+    template both consumers build).  Returns the saved epoch label."""
     import torch
 
-    from milnce_tpu.utils.torch_convert import torch_state_dict_to_flax
+    from milnce_tpu.config import OptimConfig
+    from milnce_tpu.train.checkpoint import CheckpointManager
+    from milnce_tpu.train.schedule import cosine_with_warmup
+    from milnce_tpu.train.state import build_optimizer, create_train_state
+    from milnce_tpu.utils.torch_convert import load_torch_checkpoint_as_flax
 
     raw = torch.load(src, map_location="cpu", weights_only=False)
-    sd = raw.get("state_dict", raw)
-    sd = {k: v.numpy() for k, v in sd.items() if hasattr(v, "numpy")}
-    variables = torch_state_dict_to_flax(sd)
-
-    import orbax.checkpoint as ocp
-
-    import os
-    path = os.path.abspath(dst)
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(os.path.join(path, "variables"), variables)
-    return len(sd)
+    epoch = int(raw.get("epoch", 0)) if isinstance(raw, dict) else 0
+    variables = load_torch_checkpoint_as_flax(src)
+    optimizer = build_optimizer(OptimConfig(), cosine_with_warmup(1e-3, 1, 2))
+    state = create_train_state(variables, optimizer)
+    mgr = CheckpointManager(dst)
+    mgr.save(epoch, state)
+    mgr.wait()
+    return epoch
 
 
 def inspect(src: str) -> None:
@@ -91,8 +95,8 @@ def main(argv=None):
         v, d = convert_word2vec(args.src, args.dst)
         print(f"wrote {args.dst}: ({v}, {d})")
     elif args.cmd == "ckpt":
-        n = convert_checkpoint(args.src, args.dst)
-        print(f"wrote {args.dst}: {n} tensors")
+        epoch = convert_checkpoint(args.src, args.dst)
+        print(f"wrote {args.dst}: run dir at epoch {epoch}")
     else:
         inspect(args.src)
 

@@ -84,3 +84,17 @@ def torch_state_dict_to_flax(state_dict: Mapping[str, np.ndarray]) -> dict:
         else:
             raise ValueError(f"unrecognized checkpoint entry: {key}")
     return {"params": params, "batch_stats": stats}
+
+
+def load_torch_checkpoint_as_flax(path: str) -> dict:
+    """torch.load a reference checkpoint file — either flavor
+    (eval_msrvtt.py:21-32): the DDP ``{'state_dict': ...}`` wrapper or the
+    upstream flat table — and convert to Flax variables.  The one place
+    the library imports torch (train resume, eval CLI and the assets
+    converter all route through here)."""
+    import torch
+
+    raw = torch.load(path, map_location="cpu", weights_only=False)
+    sd = raw.get("state_dict", raw) if isinstance(raw, dict) else raw
+    sd = {k: v.numpy() for k, v in sd.items() if hasattr(v, "numpy")}
+    return torch_state_dict_to_flax(sd)

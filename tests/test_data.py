@@ -193,6 +193,68 @@ def test_hmdb_label_stripping():
     assert HMDBSource.label_of("wave") == "wave"
 
 
+class TestManifestTool:
+    @pytest.fixture
+    def tree(self, tmp_path):
+        (tmp_path / "videos" / "a").mkdir(parents=True)
+        (tmp_path / "captions").mkdir()
+        for i in range(3):
+            (tmp_path / "videos" / "a" / f"vid{i}.mp4").write_bytes(b"x")
+        (tmp_path / "videos" / "notes.txt").write_text("not a video")
+        for i in range(2):   # captions only for vid0/vid1
+            (tmp_path / "captions" / f"vid{i}.json").write_text(
+                json.dumps({"start": [0], "end": [5], "text": ["hi"]}))
+        return tmp_path
+
+    def test_build_and_validate_roundtrip(self, tree):
+        from milnce_tpu.data.manifest import build, validate
+
+        out = tree / "train.csv"
+        n, skipped = build(str(tree / "videos"), str(out),
+                           caption_root=str(tree / "captions"))
+        assert (n, skipped) == (2, 1)        # vid2 has no captions
+        rep = validate(str(out), video_root=str(tree / "videos"),
+                       caption_root=str(tree / "captions"))
+        assert rep == {"rows": 2, "missing_video": 0,
+                       "missing_captions": 0, "bad_captions": 0}
+
+    def test_built_manifest_feeds_the_source(self, tree):
+        from milnce_tpu.data.datasets import HowTo100MSource
+        from milnce_tpu.data.manifest import build
+
+        out = tree / "train.csv"
+        build(str(tree / "videos"), str(out),
+              caption_root=str(tree / "captions"))
+        cfg = tiny_preset()
+        cfg.data.train_csv = str(out)
+        cfg.data.video_root = str(tree / "videos")
+        cfg.data.caption_root = str(tree / "captions")
+        tok = Tokenizer(["hi"], cfg.data.max_words)
+        src = HowTo100MSource(cfg.data, cfg.model, decoder=FakeDecoder(),
+                              tokenizer=tok)
+        s = src.sample(0, np.random.RandomState(0))
+        assert s["video"].shape[0] == cfg.data.num_frames
+
+    def test_validate_flags_problems(self, tree):
+        from milnce_tpu.data.manifest import build, validate
+
+        out = tree / "all.csv"
+        build(str(tree / "videos"), str(out))    # includes caption-less vid2
+        (tree / "captions" / "vid1.json").write_text("{not json")
+        rep = validate(str(out), caption_root=str(tree / "captions"))
+        assert rep["rows"] == 3
+        assert rep["missing_captions"] == 1      # vid2
+        assert rep["bad_captions"] == 1          # vid1
+
+    def test_cli(self, tree, capsys):
+        from milnce_tpu.data.manifest import main
+
+        rc = main(["build", str(tree / "videos"), "--out",
+                   str(tree / "m.csv")])
+        assert rc == 0
+        assert "3 videos" in capsys.readouterr().out
+
+
 def test_ffmpeg_decoder_gated_without_binary(monkeypatch):
     from milnce_tpu.data.video import FFmpegDecoder
 
