@@ -46,23 +46,30 @@ def _interpret() -> bool:
 
 # ---------------------------------------------------------------- forward
 def _fwd_kernel(d_ref, val_ref, r_ref, *, n: int, m: int, gamma: float,
-                bandwidth: int):
-    """One batch element.  d_ref: (1, N+M-1, N) skewed costs.
-    r_ref: (1, N+M+1, N+1) skewed DP table (padded coords, diag-major).
-    val_ref: (1, 1) final alignment cost."""
+                bandwidth: int, bt: int):
+    """A TILE of ``bt`` batch elements per grid block.  d_ref:
+    (bt, N+M-1, N) skewed costs.  r_ref: (bt, N+M+1, N+1) skewed DP
+    tables (padded coords, diag-major).  val_ref: (bt, 1) final costs.
+
+    The CUDA reference runs one *block per pair* with one thread per
+    row; a 1-pair-per-block Pallas port leaves the 8x128 VPU mostly
+    idle when N is small (alignment lengths here are 8-32 frames, and
+    SDTW_3 evaluates B^2 pairs).  Tiling the batch into the block makes
+    every wavefront step a (bt, N+1) vector op — batch fills the lanes
+    the diagonal can't."""
     n1 = n + 1
-    i_buf = lax.broadcasted_iota(jnp.int32, (1, n1), 1)
+    i_buf = lax.broadcasted_iota(jnp.int32, (bt, n1), 1)
 
     # Diagonal 0: R[0,0] = 0, rest BIG.  Diagonal 1: all BIG (borders).
-    r_ref[0, 0, :] = jnp.where(i_buf == 0, 0.0, BIG)[0]
-    r_ref[0, 1, :] = jnp.full((n1,), BIG, jnp.float32)
+    r_ref[:, 0, :] = jnp.where(i_buf == 0, 0.0, BIG)
+    r_ref[:, 1, :] = jnp.full((bt, n1), BIG, jnp.float32)
 
     inv_gamma = 1.0 / gamma
 
     def body(p, _):
-        r_mm = r_ref[0, p - 2, :][None, :]          # diag p-2
-        r_m = r_ref[0, p - 1, :][None, :]           # diag p-1
-        cost = d_ref[0, p - 2, :][None, :]          # D[i-1, j-1] along diag p
+        r_mm = r_ref[:, p - 2, :]                   # diag p-2: (bt, N+1)
+        r_m = r_ref[:, p - 1, :]                    # diag p-1
+        cost = d_ref[:, p - 2, :]                   # D[i-1, j-1] along diag p
         prev_diag = r_mm[:, :-1]                    # R[i-1, j-1]
         prev_up = r_m[:, :-1]                       # R[i-1, j]
         prev_left = r_m[:, 1:]                      # R[i, j-1]
@@ -74,16 +81,16 @@ def _fwd_kernel(d_ref, val_ref, r_ref, *, n: int, m: int, gamma: float,
                                     + jnp.exp(n2 - mx)) + mx)
         interior = cost + softmin                   # i = 1..N
         row = jnp.concatenate(
-            [jnp.full((1, 1), BIG, jnp.float32), interior], axis=1)
+            [jnp.full((bt, 1), BIG, jnp.float32), interior], axis=1)
         j_buf = p - i_buf
         valid = ((i_buf >= 1) & (j_buf >= 1) & (j_buf <= m))
         if bandwidth > 0:                           # soft_dtw_cuda.py:66
             valid &= jnp.abs(i_buf - j_buf) <= bandwidth
-        r_ref[0, p, :] = jnp.where(valid, row, BIG)[0]
+        r_ref[:, p, :] = jnp.where(valid, row, BIG)
         return 0
 
     lax.fori_loop(2, n + m + 1, body, 0)
-    val_ref[0, 0] = r_ref[0, n + m, n]
+    val_ref[:, 0] = r_ref[:, n + m, n]
 
 
 def _fwd_kernel_chunked(d_ref, val_ref, r_ref, carry, *, n: int, m: int,
@@ -223,52 +230,75 @@ def _softdtw_bwd_scan(r_ext: jax.Array, d_ext_skew: jax.Array, n: int,
 _VMEM_TABLE_BUDGET = 2_000_000  # floats
 
 
+def _batch_tile(bsz: int, n: int, m: int) -> int:
+    """Elements per block: as many as keep the block's WHOLE resident set
+    inside the VMEM budget, capped at 128 sublane-friendly elements.
+
+    The backward block is the high-water mark — THREE (N+M+3)x(N+2)
+    tables per element (r/d/e refs; forward holds two) — so the budget
+    divides by 3x the table size: a tile the backward can hold, the
+    forward holds with headroom for Pallas double-buffering."""
+    table = (n + m + 3) * (n + 2)
+    return max(1, min(bsz, _VMEM_TABLE_BUDGET // (3 * table), 128))
+
+
+def _pad_batch(x: jax.Array, bt: int) -> jax.Array:
+    bsz = x.shape[0]
+    pad = (-bsz) % bt
+    return x if pad == 0 else jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1))
+
+
 def _run_forward(d_skew: jax.Array, n: int, m: int, gamma: float,
                  bandwidth: int):
     bsz = d_skew.shape[0]
+    bt = _batch_tile(bsz, n, m)
+    d_pad = _pad_batch(d_skew, bt)
     kernel = functools.partial(_fwd_kernel, n=n, m=m, gamma=gamma,
-                               bandwidth=bandwidth)
+                               bandwidth=bandwidth, bt=bt)
+    grid = (d_pad.shape[0] // bt,)
     value, r_skew = pl.pallas_call(
         kernel,
-        grid=(bsz,),
-        in_specs=[pl.BlockSpec((1, n + m - 1, n), lambda b: (b, 0, 0))],
-        out_specs=[pl.BlockSpec((1, 1), lambda b: (b, 0)),
-                   pl.BlockSpec((1, n + m + 1, n + 1), lambda b: (b, 0, 0))],
-        out_shape=[jax.ShapeDtypeStruct((bsz, 1), jnp.float32),
-                   jax.ShapeDtypeStruct((bsz, n + m + 1, n + 1), jnp.float32)],
+        grid=grid,
+        in_specs=[pl.BlockSpec((bt, n + m - 1, n), lambda b: (b, 0, 0))],
+        out_specs=[pl.BlockSpec((bt, 1), lambda b: (b, 0)),
+                   pl.BlockSpec((bt, n + m + 1, n + 1), lambda b: (b, 0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((d_pad.shape[0], 1), jnp.float32),
+                   jax.ShapeDtypeStruct((d_pad.shape[0], n + m + 1, n + 1),
+                                        jnp.float32)],
         interpret=_interpret(),
-    )(d_skew)
-    return value[:, 0], r_skew
+    )(d_pad)
+    return value[:bsz, 0], r_skew[:bsz]
 
 
 # --------------------------------------------------------------- backward
 def _bwd_kernel(r_ref, d_ref, e_ref, *, n: int, m: int, gamma: float,
-                bandwidth: int):
+                bandwidth: int, bt: int):
     """Reverse wavefront over padded-extended coords i in [0,N+1],
-    j in [0,M+1] (diag q = i+j in [0, N+M+2]), skewed layout.
-    r_ref/d_ref/e_ref: (1, N+M+3, N+2)."""
+    j in [0,M+1] (diag q = i+j in [0, N+M+2]), skewed layout, a tile of
+    ``bt`` batch elements per block (see _fwd_kernel on why).
+    r_ref/d_ref/e_ref: (bt, N+M+3, N+2)."""
     n2 = n + 2
-    i_buf = lax.broadcasted_iota(jnp.int32, (1, n2), 1)
+    i_buf = lax.broadcasted_iota(jnp.int32, (bt, n2), 1)
     inv_gamma = 1.0 / gamma
 
-    e_ref[0] = jnp.zeros((n + m + 3, n2), jnp.float32)
+    e_ref[:, :, :] = jnp.zeros((bt, n + m + 3, n2), jnp.float32)
     # E[N+1, M+1] = 1 (corner seed, soft_dtw_cuda.py:166-167)
     corner = (i_buf == n + 1).astype(jnp.float32)
-    e_ref[0, n + m + 2, :] = corner[0]
+    e_ref[:, n + m + 2, :] = corner
 
     def shift_left(row):                            # row[i] -> row[i+1]
         return jnp.concatenate(
-            [row[:, 1:], jnp.zeros((1, 1), row.dtype)], axis=1)
+            [row[:, 1:], jnp.zeros((bt, 1), row.dtype)], axis=1)
 
     def body(k, _):
         q = n + m + 2 - k
-        r_q = r_ref[0, q, :][None, :]               # R[i, q-i]
-        r_q1 = r_ref[0, q + 1, :][None, :]          # diag q+1
-        r_q2 = r_ref[0, q + 2, :][None, :]          # diag q+2
-        d_q1 = d_ref[0, q + 1, :][None, :]
-        d_q2 = d_ref[0, q + 2, :][None, :]
-        e_q1 = e_ref[0, q + 1, :][None, :]
-        e_q2 = e_ref[0, q + 2, :][None, :]
+        r_q = r_ref[:, q, :]                        # R[i, q-i]: (bt, N+2)
+        r_q1 = r_ref[:, q + 1, :]                   # diag q+1
+        r_q2 = r_ref[:, q + 2, :]                   # diag q+2
+        d_q1 = d_ref[:, q + 1, :]
+        d_q2 = d_ref[:, q + 2, :]
+        e_q1 = e_ref[:, q + 1, :]
+        e_q2 = e_ref[:, q + 2, :]
 
         r_up = shift_left(r_q1)                     # R[i+1, j]
         r_left = r_q1                               # R[i, j+1]
@@ -290,7 +320,7 @@ def _bwd_kernel(r_ref, d_ref, e_ref, *, n: int, m: int, gamma: float,
                  & (r_q > -BIG / 2))                # unreached cells -> 0
         if bandwidth > 0:
             valid &= jnp.abs(i_buf - j_buf) <= bandwidth
-        e_ref[0, q, :] = jnp.where(valid, e_row, 0.0)[0]
+        e_ref[:, q, :] = jnp.where(valid, e_row, 0.0)
         return 0
 
     # Start at q = n+m (k=2): diagonal n+m+1 holds no valid cell (j would
@@ -301,17 +331,22 @@ def _bwd_kernel(r_ref, d_ref, e_ref, *, n: int, m: int, gamma: float,
 def _run_backward(r_ext_skew: jax.Array, d_ext_skew: jax.Array, n: int,
                   m: int, gamma: float, bandwidth: int) -> jax.Array:
     bsz = r_ext_skew.shape[0]
+    bt = _batch_tile(bsz, n, m)
+    r_pad = _pad_batch(r_ext_skew, bt)
+    d_pad = _pad_batch(d_ext_skew, bt)
     kernel = functools.partial(_bwd_kernel, n=n, m=m, gamma=gamma,
-                               bandwidth=bandwidth)
-    spec = pl.BlockSpec((1, n + m + 3, n + 2), lambda b: (b, 0, 0))
-    return pl.pallas_call(
+                               bandwidth=bandwidth, bt=bt)
+    spec = pl.BlockSpec((bt, n + m + 3, n + 2), lambda b: (b, 0, 0))
+    out = pl.pallas_call(
         kernel,
-        grid=(bsz,),
+        grid=(r_pad.shape[0] // bt,),
         in_specs=[spec, spec],
         out_specs=spec,
-        out_shape=jax.ShapeDtypeStruct((bsz, n + m + 3, n + 2), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((r_pad.shape[0], n + m + 3, n + 2),
+                                       jnp.float32),
         interpret=_interpret(),
-    )(r_ext_skew, d_ext_skew)
+    )(r_pad, d_pad)
+    return out[:bsz]
 
 
 # ----------------------------------------------------------- custom VJP

@@ -96,6 +96,11 @@ def profile(batch_size: int, seq_len_a: int, seq_len_b: int, dims: int,
 
 
 if __name__ == "__main__":
+    import os
+
+    if os.environ.get("MILNCE_PROFILE_CPU") == "1":
+        # escape hatch for hosts whose accelerator tunnel is down
+        jax.config.update("jax_platforms", "cpu")
     if len(sys.argv) == 5:
         shapes = [tuple(int(a) for a in sys.argv[1:])]
     else:

@@ -63,3 +63,17 @@ def test_rectangular_extreme():
     D = jnp.asarray(rng.rand(1, 2, 12).astype(np.float32))
     np.testing.assert_allclose(np.asarray(softdtw_pallas(D, 1.0)),
                                np.asarray(softdtw_scan(D, 1.0)), rtol=1e-5)
+
+
+def test_batch_tiling_pads_and_slices():
+    """Batches above the 128-element tile cap split into multiple padded
+    blocks (fwd AND bwd); values/grads must match the scan exactly."""
+    rng = np.random.RandomState(6)
+    D = jnp.asarray(rng.rand(130, 4, 4).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(softdtw_pallas(D, 0.5)),
+                               np.asarray(softdtw_scan(D, 0.5)),
+                               rtol=1e-5, atol=1e-5)
+    got = jax.grad(lambda d: softdtw_pallas(d, 0.5).sum())(D)
+    want = jax.grad(lambda d: softdtw_scan(d, 0.5).sum())(D)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-3, atol=1e-5)
