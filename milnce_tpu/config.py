@@ -31,7 +31,9 @@ class DataConfig:
     center_crop: bool = False
     random_flip: bool = True
     min_time: float = 5.0
-    max_words: int = 20
+    max_words: int = 20                 # training caption length
+    eval_max_words: int = 30            # eval caption length (youcook/msrvtt
+                                        # loaders, youcook_loader.py:28)
     num_candidates: int = 5             # MIL candidate captions per clip
     num_reader_threads: int = 20        # host-side decode workers per process
     use_native_reader: bool = False     # C++ ReaderPool pipe pump for ffmpeg
@@ -117,6 +119,7 @@ class TrainConfig:
     resume: bool = False
     pretrain_ckpt: str = ""             # load converted weights before training
     evaluate: bool = False
+    eval_task: str = "hmdb"             # hmdb | youcook | msrvtt (in-training)
     num_windows_test: int = 4
     verbose: bool = True
     trace_dir: str = ""                 # jax.profiler trace output ('' = off)

@@ -22,11 +22,8 @@ import jax
 import numpy as np
 
 from milnce_tpu.config import DataConfig, ModelConfig
-from milnce_tpu.data.datasets import (HMDBSource, MSRVTTSource, YouCookSource,
-                                      build_tokenizer)
-from milnce_tpu.eval.linear_probe import evaluate_linear_probe
+from milnce_tpu.data.datasets import build_tokenizer
 from milnce_tpu.eval.metrics import format_metrics
-from milnce_tpu.eval.retrieval import evaluate_retrieval
 from milnce_tpu.models.build import build_model
 from milnce_tpu.parallel.mesh import build_mesh
 from milnce_tpu.config import ParallelConfig
@@ -119,22 +116,20 @@ def main(argv=None):
 
     variables = jax.device_put(variables, NamedSharding(mesh, P()))
 
-    if args.task == "hmdb":
-        source = HMDBSource(args.csv, args.video_root, data_cfg,
-                            num_clip=args.num_windows, decoder=decoder)
-        accs = evaluate_linear_probe(model, variables, source, mesh)
-        for k, v in accs.items():
-            print(f"HMDB top-1 {k}: {v:.4f}")
-        return accs
+    from milnce_tpu.eval.runner import evaluate_task
 
-    tokenizer = build_tokenizer(model_cfg, args.max_words)
-    cls = YouCookSource if args.task == "youcook" else MSRVTTSource
-    source = cls(args.csv, args.video_root, data_cfg, tokenizer,
-                 num_clip=args.num_windows, max_words=args.max_words,
-                 decoder=decoder)
-    metrics = evaluate_retrieval(model, variables, source, mesh,
-                                 batch_size=args.batch_size)
-    print(format_metrics(metrics))
+    tokenizer = (None if args.task == "hmdb"
+                 else build_tokenizer(model_cfg, args.max_words))
+    metrics = evaluate_task(
+        args.task, model, variables, mesh, data_cfg=data_cfg,
+        csv_path=args.csv, video_root=args.video_root, tokenizer=tokenizer,
+        num_clip=args.num_windows, batch_size=args.batch_size,
+        decoder=decoder, max_words=args.max_words)
+    if args.task == "hmdb":
+        for k, v in metrics.items():
+            print(f"HMDB top-1 {k}: {v:.4f}")
+    else:
+        print(format_metrics(metrics))
     return metrics
 
 

@@ -1,0 +1,44 @@
+"""Shared eval-task dispatch: one place that knows how to turn
+(task name, manifest, video root) into metrics — used by BOTH the eval
+CLI and the in-training evaluator so the two can't drift (the reference
+duplicated its eval loop into each eval_*.py script AND the trainers,
+where the trainer copy rotted into dead code, SURVEY §2.4 #35).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from milnce_tpu.config import DataConfig
+from milnce_tpu.data.datasets import HMDBSource, MSRVTTSource, YouCookSource
+
+EVAL_TASKS = ("hmdb", "youcook", "msrvtt")
+
+
+def evaluate_task(task: str, model, variables, mesh, *, data_cfg: DataConfig,
+                  csv_path: str, video_root: str, tokenizer=None,
+                  num_clip: int = 4, batch_size: int = 16,
+                  decoder=None, max_words: int = 30) -> dict:
+    """Run one downstream eval task; returns its metrics dict
+    (R@k/MedR for retrieval, per-split accuracy for the probe).
+
+    ``tokenizer`` is required for the retrieval tasks; ``decoder=None``
+    uses ffmpeg (pass a FakeDecoder for hermetic runs)."""
+    if task not in EVAL_TASKS:
+        raise ValueError(f"unknown eval task {task!r}; expected one of "
+                         f"{'|'.join(EVAL_TASKS)}")
+    if task == "hmdb":
+        from milnce_tpu.eval.linear_probe import evaluate_linear_probe
+
+        source = HMDBSource(csv_path, video_root, data_cfg,
+                            num_clip=num_clip, decoder=decoder)
+        return evaluate_linear_probe(model, variables, source, mesh)
+
+    from milnce_tpu.eval.retrieval import evaluate_retrieval
+
+    assert tokenizer is not None, "retrieval tasks need a tokenizer"
+    cls = YouCookSource if task == "youcook" else MSRVTTSource
+    source = cls(csv_path, video_root, data_cfg, tokenizer,
+                 num_clip=num_clip, decoder=decoder, max_words=max_words)
+    return evaluate_retrieval(model, variables, source, mesh,
+                              batch_size=batch_size)

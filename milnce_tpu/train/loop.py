@@ -52,19 +52,45 @@ class TrainResult:
 
 def _in_training_eval(cfg: Config, model, state: TrainState, mesh,
                       logger) -> None:
-    """HMDB linear probe during training (the reference's intent at
-    main_distributed.py:243-287)."""
-    from milnce_tpu.data.datasets import HMDBSource
-    from milnce_tpu.eval.linear_probe import evaluate_linear_probe
+    """Periodic downstream eval during training.  The reference intended
+    an HMDB probe here but shipped it dead (main_distributed.py:243-287,
+    NameError'd test_loader — SURVEY §2.4); ours runs, and also covers
+    the retrieval tasks (train.eval_task: hmdb | youcook | msrvtt).
+    Dispatch is shared with the eval CLI (eval/runner.py)."""
+    from milnce_tpu.data.datasets import build_tokenizer
+    from milnce_tpu.eval.runner import evaluate_task
 
-    source = HMDBSource(cfg.data.eval_csv, cfg.data.eval_video_root,
-                        cfg.data, num_clip=cfg.train.num_windows_test)
+    decoder = None
+    if cfg.data.synthetic:      # hermetic runs eval on the fake decoder too
+        from milnce_tpu.data.video import FakeDecoder
+
+        decoder = FakeDecoder()
     variables = {"params": state.params, "batch_stats": state.batch_stats}
-    accs = evaluate_linear_probe(model, variables, source, mesh)
-    logger.log(f"HMDB linear probe: {accs}")
+    task = cfg.train.eval_task
+    tokenizer = (None if task == "hmdb" else
+                 build_tokenizer(cfg.model, cfg.data.eval_max_words))
+    metrics = evaluate_task(
+        task, model, variables, mesh, data_cfg=cfg.data,
+        csv_path=cfg.data.eval_csv, video_root=cfg.data.eval_video_root,
+        tokenizer=tokenizer, num_clip=cfg.train.num_windows_test,
+        batch_size=cfg.train.batch_size_val, decoder=decoder,
+        max_words=cfg.data.eval_max_words)
+    if task == "hmdb":
+        logger.log(f"HMDB linear probe: {metrics}")
+    else:
+        from milnce_tpu.eval.metrics import format_metrics
+
+        logger.log(f"{task} retrieval: {format_metrics(metrics)}")
 
 
 def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
+    if cfg.train.evaluate:
+        from milnce_tpu.eval.runner import EVAL_TASKS
+
+        if cfg.train.eval_task not in EVAL_TASKS:   # fail before any init
+            raise ValueError(
+                f"unknown train.eval_task {cfg.train.eval_task!r}; "
+                f"expected one of {'|'.join(EVAL_TASKS)}")
     initialize_distributed(cfg.parallel)
     mesh = build_mesh(cfg.parallel)
     axis = cfg.parallel.data_axis
