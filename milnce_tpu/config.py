@@ -39,6 +39,8 @@ class DataConfig:
     use_native_reader: bool = False     # C++ ReaderPool pipe pump for ffmpeg
                                         # decode (native/milnce_native.cpp)
     prefetch_depth: int = 2             # device prefetch buffer (batches)
+    decode_lookahead: int = 2           # extra batches of decode futures kept
+                                        # in flight across batch boundaries
     synthetic: bool = False             # hermetic in-memory source (no ffmpeg)
     synthetic_num_samples: int = 256
 

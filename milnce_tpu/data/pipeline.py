@@ -12,8 +12,9 @@ Replaces torch ``DataLoader + DistributedSampler`` (main_distributed.py:
 - batches stay **uint8** end-to-end and are handed to
   :func:`device_prefetch`, which keeps ``depth`` batches in flight on
   device (async ``device_put``) so host decode overlaps device compute;
-- ``drop_last=True`` semantics: only full GLOBAL batches are emitted
-  (a short epoch tail never stalls a pod step — SURVEY.md §7 hard part 2).
+- only full GLOBAL batches are emitted (torch drop_last=True semantics:
+  a short epoch tail can't shard evenly over the mesh and would need its
+  own compiled step — SURVEY.md §7 hard part 2).
 """
 
 from __future__ import annotations
@@ -28,13 +29,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 class ShardedLoader:
-    """Iterates a source (len + sample(idx, rng)) as per-host batches."""
+    """Iterates a source (len + sample(idx, rng)) as per-host batches.
+
+    Decode is PIPELINED across batch boundaries: a sliding window of
+    ``(1 + lookahead_batches) * local_batch`` sample futures stays in
+    flight, so the reader threads are already decoding batch k+1 (and
+    k+2) while batch k is being stacked/consumed — a per-batch
+    ``pool.map`` would drain to a barrier at every batch edge and idle
+    the readers exactly when the device is waiting on data.  Sample
+    content is a pure function of (seed, epoch, index), so scheduling
+    never changes what a batch contains."""
 
     def __init__(self, source, global_batch_size: int, seed: int = 0,
                  num_threads: int = 8, shuffle: bool = True,
                  process_index: Optional[int] = None,
                  process_count: Optional[int] = None,
-                 drop_last: bool = True):
+                 lookahead_batches: int = 2):
         self.source = source
         self.global_batch = int(global_batch_size)
         self.seed = seed
@@ -44,14 +54,19 @@ class ShardedLoader:
         self.pc = jax.process_count() if process_count is None else process_count
         assert self.global_batch % self.pc == 0, (global_batch_size, self.pc)
         self.local_batch = self.global_batch // self.pc
-        self.drop_last = drop_last
+        self.lookahead_batches = max(0, int(lookahead_batches))
 
     def steps_per_epoch(self) -> int:
-        n = len(self.source)
-        return n // self.global_batch if self.drop_last else -(-n // self.global_batch)
+        # Tail always dropped: a short global batch cannot shard evenly
+        # over the mesh, and the SPMD step compiles for ONE static batch
+        # shape — there is deliberately no drop_last=False (a ragged tail
+        # would need its own XLA program per tail size).
+        return len(self.source) // self.global_batch
 
     def epoch(self, epoch: int) -> Iterator[dict]:
         """Yield this host's batches for one epoch (dicts of stacked np)."""
+        import collections
+
         n = len(self.source)
         order = np.arange(n)
         if self.shuffle:
@@ -60,16 +75,29 @@ class ShardedLoader:
         order = order[:usable]
         # host h takes rows h, h+pc, h+2pc... of each global batch
         local = order.reshape(-1, self.global_batch)[:, self.pi::self.pc]
+        flat = local.reshape(-1)
 
         rng_base = self.seed * 100_003 + epoch
-        with cf.ThreadPoolExecutor(self.num_threads) as pool:
+        pool = cf.ThreadPoolExecutor(self.num_threads)
+        try:
             def fetch(idx):
                 return self.source.sample(
                     int(idx), np.random.RandomState((rng_base + int(idx)) % (2**31)))
 
-            for batch_ids in local:
-                samples = list(pool.map(fetch, batch_ids))
+            futs: "collections.deque" = collections.deque()
+            window = self.local_batch * (1 + self.lookahead_batches)
+            submitted = 0
+            for start in range(0, len(flat), self.local_batch):
+                while submitted < len(flat) and submitted < start + window:
+                    futs.append(pool.submit(fetch, flat[submitted]))
+                    submitted += 1
+                samples = [futs.popleft().result()
+                           for _ in range(self.local_batch)]
                 yield {k: np.stack([s[k] for s in samples]) for k in samples[0]}
+        finally:
+            # generator may be closed mid-epoch (max_steps / preemption):
+            # drop queued decodes instead of draining them
+            pool.shutdown(wait=False, cancel_futures=True)
 
 
 def device_prefetch(iterator: Iterator[dict], mesh: Mesh,

@@ -102,7 +102,8 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
 
     source = build_source(cfg)
     loader = ShardedLoader(source, cfg.train.batch_size, seed=cfg.train.seed,
-                           num_threads=cfg.data.num_reader_threads)
+                           num_threads=cfg.data.num_reader_threads,
+                           lookahead_batches=cfg.data.decode_lookahead)
     steps_per_epoch = loader.steps_per_epoch()
     assert steps_per_epoch > 0, "dataset smaller than one global batch"
 
