@@ -36,7 +36,7 @@ from milnce_tpu.config import DataConfig, ModelConfig
 from milnce_tpu.data.captions import CaptionTrack, sample_caption
 from milnce_tpu.data.tokenizer import Tokenizer, synthetic_vocab
 from milnce_tpu.data.video import (ClipDecoder, FFmpegDecoder, eval_windows,
-                                   pad_or_trim, sample_clip)
+                                   sample_clip)
 
 
 def read_csv(path: str) -> list[dict]:

@@ -20,8 +20,7 @@ Replaces torch ``DataLoader + DistributedSampler`` (main_distributed.py:
 from __future__ import annotations
 
 import concurrent.futures as cf
-import itertools
-from typing import Callable, Iterator, Optional
+from typing import Iterator, Optional
 
 import jax
 import numpy as np

@@ -19,7 +19,6 @@ import argparse
 import os
 
 import jax
-import numpy as np
 
 from milnce_tpu.config import DataConfig, ModelConfig
 from milnce_tpu.data.datasets import build_tokenizer

@@ -11,8 +11,6 @@ sklearn runs on host; feature extraction is the jitted sharded forward.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import numpy as np
 from jax.sharding import Mesh
 

@@ -13,8 +13,6 @@ reference (:70-72).
 
 from __future__ import annotations
 
-from typing import Iterable
-
 import jax
 import numpy as np
 from jax.sharding import Mesh

@@ -7,8 +7,6 @@ where the trainer copy rotted into dead code, SURVEY §2.4 #35).
 
 from __future__ import annotations
 
-from typing import Optional
-
 from milnce_tpu.config import DataConfig
 from milnce_tpu.data.datasets import HMDBSource, MSRVTTSource, YouCookSource
 

@@ -9,7 +9,6 @@ a=sqrt(5), i.e. U(±sqrt(3/ (3*fan_in)))); ``init='kaiming_normal'`` is
 from __future__ import annotations
 
 import jax.numpy as jnp
-from jax import nn as jnn
 from jax.nn import initializers as init
 
 

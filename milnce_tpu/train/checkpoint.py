@@ -10,7 +10,7 @@ don't stall the step loop.
 from __future__ import annotations
 
 import os
-from typing import Any, Optional, Tuple
+from typing import Optional, Tuple
 
 import orbax.checkpoint as ocp
 

@@ -22,9 +22,6 @@ all-reduce backward + Adam/SGD + scheduler step all fuse into a single
 
 from __future__ import annotations
 
-import functools
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 import optax

@@ -123,3 +123,51 @@ def test_tf_same_maxpool_matches_reference_semantics(length):
     out = _tf_same_max_pool(jnp.asarray(x), (1, 1, 3), (1, 1, 2))
     expected = _naive_ref_maxpool_1d(x[0, 0, 0, :, 0], 3, 2)
     np.testing.assert_allclose(np.asarray(out)[0, 0, 0, :, 0], expected)
+
+
+def test_sync_batchnorm_merges_stats_across_shards():
+    """bn_axis_name='data' (model.sync_batchnorm — the original TPU run's
+    cross-replica BN, README.md:13 flips the trade-off on TPU): batch
+    stats computed under shard_map over sharded data must equal the
+    stats of the FULL batch, unlike local BN which sees only its shard."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from milnce_tpu.models.s3dg import STConv3D
+
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices), ("data",))
+    b, t, hw, cin = 16, 2, 4, 3
+    rng = np.random.RandomState(0)
+    # per-shard means differ: scale each sample by its index
+    x = (rng.rand(b, t, hw, hw, cin) * np.arange(1, b + 1)[:, None, None,
+                                                          None, None]
+         ).astype(np.float32)
+
+    sync = STConv3D(features=4, kernel_size=(1, 1, 1), bn_axis_name="data")
+    variables = sync.init(jax.random.PRNGKey(0), jnp.zeros((2, t, hw, hw, cin)))
+
+    @jax.jit
+    def sharded_stats(x):
+        def local(xs):
+            _, mut = sync.apply(variables, xs, train=True,
+                                mutable=["batch_stats"])
+            return mut["batch_stats"]
+
+        return jax.shard_map(local, mesh=mesh, in_specs=P("data"),
+                             out_specs=P(), check_vma=False)(x)
+
+    with jax.set_mesh(mesh):
+        stats_sharded = sharded_stats(
+            jax.device_put(x, NamedSharding(mesh, P("data"))))
+
+    # reference: local BN over the WHOLE batch in one program
+    local_mod = STConv3D(features=4, kernel_size=(1, 1, 1))
+    _, mut_full = local_mod.apply(variables, jnp.asarray(x), train=True,
+                                  mutable=["batch_stats"])
+    np.testing.assert_allclose(
+        np.asarray(stats_sharded["bn"]["mean"]),
+        np.asarray(mut_full["batch_stats"]["bn"]["mean"]), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(stats_sharded["bn"]["var"]),
+        np.asarray(mut_full["batch_stats"]["bn"]["var"]), rtol=1e-4)
