@@ -263,6 +263,13 @@ def run_bench(on_tpu: bool):
 
 
 def _write_notes(results, best, kind, on_tpu, n_chips):
+    notes = os.path.join(_REPO, "BENCH_NOTES.md")
+    if not on_tpu and os.path.exists(notes):
+        # never clobber a real-TPU sweep with CPU-fallback numbers
+        with open(notes) as fh:
+            if "on_tpu=True" in fh.read():
+                _note("bench: keeping existing TPU BENCH_NOTES.md")
+                return
     try:
         lines = ["# BENCH notes (auto-written by bench.py)", "",
                  f"- device: {kind} x{n_chips} (on_tpu={on_tpu})",
