@@ -116,7 +116,7 @@ def _step_flops(step_fn, args):
 
 def _bench_config(dtype: str, batch: int, frames: int, size: int,
                   words: int, k: int, n_steps: int, remat: bool,
-                  inner: int = 1):
+                  inner: int = 1, s2d: bool = False):
     """Time the full train step at one operating point.
 
     ``inner`` optimizer steps run inside ONE XLA program per dispatch
@@ -136,6 +136,7 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
     cfg = full_preset()
     cfg.model.dtype = dtype
     cfg.model.remat = remat
+    cfg.model.space_to_depth = s2d
     model = build_model(cfg.model)
     mesh = build_mesh(cfg.parallel)
 
@@ -176,6 +177,7 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
         "dtype": dtype,
         "batch": batch,
         "remat": remat,
+        "s2d": s2d,
         "inner": inner,
         "step_ms": round(dt / total_steps * 1e3, 2),
         "clips_per_sec_per_chip": round(batch * total_steps / dt / n_chips, 3),
@@ -199,6 +201,10 @@ def run_bench(on_tpu: bool):
     _note(f"bench: platform={devices[0].platform} kind={kind} "
           f"n={len(devices)} peak_flops={peak}")
 
+    # opt-in: bench the space_to_depth stem (what the original TPU
+    # training used) — densifies conv1, the stage most starved on the
+    # 128-wide MXU (see BENCH_NOTES.md headroom notes)
+    s2d = os.environ.get("MILNCE_BENCH_S2D") == "1"
     if on_tpu:
         frames, size, words, k, n_steps = 16, 224, 20, 5, 24
         inner = 8
@@ -216,7 +222,7 @@ def run_bench(on_tpu: bool):
         for batch in batches:
             try:
                 r = _bench_config(dtype, batch, frames, size, words, k,
-                                  n_steps, remat, inner)
+                                  n_steps, remat, inner, s2d)
             except Exception as exc:
                 if _is_oom(exc) and not remat:
                     _note(f"bench: {dtype} batch={batch} OOM — retrying with "
@@ -224,7 +230,8 @@ def run_bench(on_tpu: bool):
                     remat = True   # larger batches can only need MORE memory
                     try:
                         r = _bench_config(dtype, batch, frames, size, words,
-                                          k, n_steps, remat=True, inner=inner)
+                                          k, n_steps, remat=True, inner=inner,
+                                          s2d=s2d)
                     except Exception as exc2:
                         _note(f"bench: {dtype} batch={batch} remat also failed: "
                               f"{type(exc2).__name__} — stopping sweep")
@@ -249,7 +256,8 @@ def run_bench(on_tpu: bool):
     value = best["clips_per_sec_per_chip"]
     out = {
         "metric": f"train_step clips/sec/chip ({frames}f@{size}, "
-                  f"{best['dtype']}, batch {best['batch']})",
+                  f"{best['dtype']}, batch {best['batch']}"
+                  + (", s2d stem" if best.get("s2d") else "") + ")",
         "value": value,
         "unit": "clips/sec/chip",
         "vs_baseline": (round(value / BASELINE_THROUGHPUT, 3)
