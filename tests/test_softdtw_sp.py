@@ -61,3 +61,13 @@ def test_gradient_matches_scan_autodiff():
     got = np.asarray(jax.grad(
         lambda d: softdtw_seq_parallel(d, 0.7, mesh).sum())(D))
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_long_sequence_beyond_reference_cap():
+    """Lengths past the reference's 1024-thread CUDA cap are the point:
+    a 512x512 alignment (table would be ~1M cells/pair) runs row-sharded
+    with each device holding 1/8 of every diagonal."""
+    D = _cost(1, 512, 512, seed=7)
+    want = np.asarray(softdtw_scan(D, 0.5))
+    got = np.asarray(softdtw_seq_parallel(D, 0.5, _mesh()))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
