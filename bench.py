@@ -29,9 +29,12 @@ import numpy as np
 _REPO = os.path.dirname(os.path.abspath(__file__))
 _CPU_CHILD_FLAG = "MILNCE_BENCH_CPU_CHILD"
 
-# clips/sec/chip anchor for vs_baseline; set from the first recorded real-TPU
-# run (BENCH_r02) so later rounds report speedup against it.
-BASELINE_THROUGHPUT = None
+# clips/sec/chip anchor for vs_baseline: the first recorded real-TPU
+# operating point (round-2 session, v5e, bfloat16 batch 256 @16f/224 —
+# BENCH_NOTES.md).  Later rounds report speedup against it.  Only
+# meaningful for on-TPU runs; CPU fallbacks report vs_baseline for
+# completeness but are not comparable.
+BASELINE_THROUGHPUT = 95.35
 
 # Peak dense matmul FLOP/s per chip (bf16), by device_kind substring.
 # Public figures; used only for the MFU diagnostic.
@@ -260,8 +263,10 @@ def run_bench(on_tpu: bool):
                   + (", s2d stem" if best.get("s2d") else "") + ")",
         "value": value,
         "unit": "clips/sec/chip",
+        # ratio vs the recorded TPU anchor — only meaningful on TPU (a
+        # CPU-fallback number against a TPU anchor would be noise)
         "vs_baseline": (round(value / BASELINE_THROUGHPUT, 3)
-                        if BASELINE_THROUGHPUT else 1.0),
+                        if BASELINE_THROUGHPUT and on_tpu else 1.0),
         "on_tpu": on_tpu,
         "device_kind": str(kind),
     }
