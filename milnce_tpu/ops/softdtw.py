@@ -171,9 +171,17 @@ class SoftDTW:
     def __call__(self, x: jax.Array, y: jax.Array) -> jax.Array:
         """x: (B, N, D), y: (B, M, D) -> (B,) alignment costs."""
         if self.normalize:                      # soft_dtw_cuda.py:376-383
-            xx = jnp.concatenate([x, x, y], axis=0)
-            yy = jnp.concatenate([y, x, y], axis=0)
-            out = self._dp(self.dist_func(xx, yy))
-            out_xy, out_xx, out_yy = jnp.split(out, 3)
+            if x.shape[1] == y.shape[1]:
+                # one batched DP over [xy, xx, yy] (the reference's trick)
+                xx = jnp.concatenate([x, x, y], axis=0)
+                yy = jnp.concatenate([y, x, y], axis=0)
+                out = self._dp(self.dist_func(xx, yy))
+                out_xy, out_xx, out_yy = jnp.split(out, 3)
+            else:
+                # unequal lengths can't share one cost-matrix shape (the
+                # reference's torch.cat would raise here); three DP calls
+                out_xy = self._dp(self.dist_func(x, y))
+                out_xx = self._dp(self.dist_func(x, x))
+                out_yy = self._dp(self.dist_func(y, y))
             return out_xy - 0.5 * (out_xx + out_yy)
         return self._dp(self.dist_func(x, y))

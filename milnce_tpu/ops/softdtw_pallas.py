@@ -9,22 +9,35 @@ wiring):
   of one pair lives in VMEM: the kernel runs a ``fori_loop`` over the
   2N-1 anti-diagonals, each step a fully-vectorized VPU op over the
   diagonal (no barriers — the sequential loop IS the dependency chain).
-- The DP table is kept in *diagonal-major (skewed) layout* so every loop
-  step is a contiguous row read/write — no scatter/gather inside the
-  kernel (the host-side skew/unskew is a one-off gather around the call).
+- Tables are kept **diagonal-major (skewed) and diagonal-LEADING**:
+  refs have shape (n_diagonals, batch_tile, N+1).  The per-step dynamic
+  index (the diagonal counter) lands on the *leading, untiled* dimension
+  — a cheap address offset in Mosaic — while the (batch_tile, N+1)
+  slices the loop actually computes on are statically-shaped, fully
+  tiled (8, 128) vector ops.  Putting the diagonal on a tiled dimension
+  instead makes every loop step a read-modify-write of the whole block
+  (measured ~300x slower than lax.scan on a v5e before this layout).
+- Batch is tiled into the block (``bt`` multiple of 8 on the sublane
+  dim): alignment lengths in the MIL-NCE regime are 8-32 frames, and
+  SDTW_3 evaluates B^2 pairs — batch fills the VPU the short diagonal
+  can't.
 - The backward pass implements the Cuturi-Blondel E-matrix recurrence as
   a reverse wavefront over the saved R table, wired in via
   ``jax.custom_vjp`` (mirror of soft_dtw_cuda.py:148-175).
 - No 1024-length cap (the CUDA block-size limit that forces the
-  reference onto its CPU path, soft_dtw_cuda.py:318-320): the diagonal
-  length is bounded only by VMEM (~16 MB => N up to several thousand).
+  reference onto its CPU path, soft_dtw_cuda.py:318-320): when the
+  per-pair tables outgrow VMEM the forward streams diagonals from HBM in
+  chunks (two carry rows of scratch) and the backward falls back to the
+  scan — the ceiling is HBM, not VMEM.
 - Borders use the same large-finite sentinel as the scan reference
   (`BIG`), with invalid cells mapped to ``-BIG`` in the backward — the
   finite analog of the reference's ``inf -> -inf`` fixup
   (soft_dtw_cuda.py:101-102).
 
 On non-TPU backends the kernel runs in Pallas interpret mode, so the
-same code path is unit-testable on CPU.
+same code path is unit-testable on CPU.  All three variants (in-VMEM,
+chunked, backward) lower through Mosaic and run compiled on real TPU
+(verified on v5e; see BENCH_NOTES.md for timings).
 """
 
 from __future__ import annotations
@@ -45,31 +58,27 @@ def _interpret() -> bool:
 
 
 # ---------------------------------------------------------------- forward
-def _fwd_kernel(d_ref, val_ref, r_ref, *, n: int, m: int, gamma: float,
+def _fwd_kernel(d_ref, r_ref, *, n: int, m: int, gamma: float,
                 bandwidth: int, bt: int):
-    """A TILE of ``bt`` batch elements per grid block.  d_ref:
-    (bt, N+M-1, N) skewed costs.  r_ref: (bt, N+M+1, N+1) skewed DP
-    tables (padded coords, diag-major).  val_ref: (bt, 1) final costs.
+    """One batch tile of ``bt`` pairs, whole wavefront in VMEM.
 
-    The CUDA reference runs one *block per pair* with one thread per
-    row; a 1-pair-per-block Pallas port leaves the 8x128 VPU mostly
-    idle when N is small (alignment lengths here are 8-32 frames, and
-    SDTW_3 evaluates B^2 pairs).  Tiling the batch into the block makes
-    every wavefront step a (bt, N+1) vector op — batch fills the lanes
-    the diagonal can't."""
+    d_ref: (N+M-1, bt, N) skewed costs.  r_ref: (N+M+1, bt, N+1) skewed
+    DP tables (padded coords).  Both diagonal-leading: ``ref[p]`` is the
+    (bt, N+1) anti-diagonal p — a static-shaped slice at a dynamic
+    leading offset."""
     n1 = n + 1
     i_buf = lax.broadcasted_iota(jnp.int32, (bt, n1), 1)
 
     # Diagonal 0: R[0,0] = 0, rest BIG.  Diagonal 1: all BIG (borders).
-    r_ref[:, 0, :] = jnp.where(i_buf == 0, 0.0, BIG)
-    r_ref[:, 1, :] = jnp.full((bt, n1), BIG, jnp.float32)
+    r_ref[0] = jnp.where(i_buf == 0, 0.0, BIG)
+    r_ref[1] = jnp.full((bt, n1), BIG, jnp.float32)
 
     inv_gamma = 1.0 / gamma
 
     def body(p, _):
-        r_mm = r_ref[:, p - 2, :]                   # diag p-2: (bt, N+1)
-        r_m = r_ref[:, p - 1, :]                    # diag p-1
-        cost = d_ref[:, p - 2, :]                   # D[i-1, j-1] along diag p
+        r_mm = r_ref[p - 2]                         # diag p-2: (bt, N+1)
+        r_m = r_ref[p - 1]                          # diag p-1
+        cost = d_ref[p - 2]                         # D[i-1, j-1] along diag p
         prev_diag = r_mm[:, :-1]                    # R[i-1, j-1]
         prev_up = r_m[:, :-1]                       # R[i-1, j]
         prev_left = r_m[:, 1:]                      # R[i, j-1]
@@ -86,16 +95,15 @@ def _fwd_kernel(d_ref, val_ref, r_ref, *, n: int, m: int, gamma: float,
         valid = ((i_buf >= 1) & (j_buf >= 1) & (j_buf <= m))
         if bandwidth > 0:                           # soft_dtw_cuda.py:66
             valid &= jnp.abs(i_buf - j_buf) <= bandwidth
-        r_ref[:, p, :] = jnp.where(valid, row, BIG)
+        r_ref[p] = jnp.where(valid, row, BIG)
         return 0
 
     lax.fori_loop(2, n + m + 1, body, 0)
-    val_ref[:, 0] = r_ref[:, n + m, n]
 
 
-def _fwd_kernel_chunked(d_ref, val_ref, r_ref, carry, *, n: int, m: int,
-                        gamma: float, bandwidth: int, chunk: int):
-    """Streaming forward: grid (B, n_chunks), diagonals arrive in
+def _fwd_kernel_chunked(d_ref, r_ref, carry, *, n: int, m: int,
+                        gamma: float, bandwidth: int, chunk: int, bt: int):
+    """Streaming forward: grid (B/bt, n_chunks), diagonals arrive in
     CHUNK-sized blocks from HBM; only two carry rows live across chunks
     (VMEM scratch).  Removes the all-diagonals-in-VMEM requirement, so the
     sequence-length ceiling is HBM, not VMEM (the reference's ceiling was
@@ -103,22 +111,24 @@ def _fwd_kernel_chunked(d_ref, val_ref, r_ref, carry, *, n: int, m: int,
 
     Block t of chunk c holds diagonal p = c*chunk + t + 2; r_ref stores
     diagonals >= 2 (diagonals 0/1 are constants, re-attached host-side).
+    The chunk index is the fast grid axis, so for each batch tile the
+    chunks arrive in order and the carry threads through.
     """
     n1 = n + 1
     c = pl.program_id(1)
-    i_buf = lax.broadcasted_iota(jnp.int32, (1, n1), 1)
+    i_buf = lax.broadcasted_iota(jnp.int32, (bt, n1), 1)
     inv_gamma = 1.0 / gamma
 
     @pl.when(c == 0)
     def _init():
-        carry[0, :] = jnp.where(i_buf == 0, 0.0, BIG)[0]     # diag 0
-        carry[1, :] = jnp.full((n1,), BIG, jnp.float32)      # diag 1
+        carry[0] = jnp.where(i_buf == 0, 0.0, BIG)           # diag 0
+        carry[1] = jnp.full((bt, n1), BIG, jnp.float32)      # diag 1
 
     def body(t, _):
         p = c * chunk + t + 2
-        r_mm = carry[0, :][None, :]
-        r_m = carry[1, :][None, :]
-        cost = d_ref[0, t, :][None, :]
+        r_mm = carry[0]
+        r_m = carry[1]
+        cost = d_ref[t]                              # (bt, N)
         n0 = -r_mm[:, :-1] * inv_gamma
         n1_ = -r_m[:, :-1] * inv_gamma
         n2 = -r_m[:, 1:] * inv_gamma
@@ -126,55 +136,125 @@ def _fwd_kernel_chunked(d_ref, val_ref, r_ref, carry, *, n: int, m: int,
         softmin = -gamma * (jnp.log(jnp.exp(n0 - mx) + jnp.exp(n1_ - mx)
                                     + jnp.exp(n2 - mx)) + mx)
         row = jnp.concatenate(
-            [jnp.full((1, 1), BIG, jnp.float32), cost + softmin], axis=1)
+            [jnp.full((bt, 1), BIG, jnp.float32), cost + softmin], axis=1)
         j_buf = p - i_buf
         valid = ((i_buf >= 1) & (j_buf >= 1) & (j_buf <= m))
         if bandwidth > 0:
             valid &= jnp.abs(i_buf - j_buf) <= bandwidth
-        row = jnp.where(valid, row, BIG)[0]
-        r_ref[0, t, :] = row
-        carry[0, :] = r_m[0]
-        carry[1, :] = row
-
-        @pl.when(p == n + m)
-        def _final():
-            val_ref[0, 0] = row[n]
-
+        row = jnp.where(valid, row, BIG)
+        r_ref[t] = row
+        carry[0] = r_m
+        carry[1] = row
         return 0
 
     lax.fori_loop(0, chunk, body, 0)
 
 
+# Budget (in f32 elements) for the per-block VMEM resident set of the
+# single-shot kernels.  The backward holds THREE (N+M+3)x(N+2) tables per
+# pair and Pallas double-buffers HBM<->VMEM, so the worst case is
+# ~6x table x bt x 4 bytes plus temporaries; 1.2M elements keeps that
+# under ~11 MB of the ~16 MB/core (verified against a real v5e scoped-
+# vmem OOM at 1.9M-element blocks).
+_VMEM_TABLE_BUDGET = 1_200_000
+
+_CHUNK_VMEM_ELEMS = 500_000  # chunked-path block budget (d+r, dbl-buffered)
+
+
+def _batch_tile(n: int, m: int) -> int:
+    """Pairs per block, multiple of 8 (Mosaic sublane tiling), capped at
+    128.  0 means even an 8-pair tile busts the VMEM budget — callers
+    must take the streaming/scan long-sequence path.
+
+    Extra cap (empirical, v5e libtpu 2026-07): grids whose
+    (leading-dim x batch-tile) block area is too large crash Mosaic's
+    vector lowering (`Check failed: limits[i] <= dim(i)` in
+    vector_extract_strided_slice).  Bisected boundaries: the forward
+    survives products up to ~8192 (65x128 dies, 65x120 ok); the backward
+    dies earlier (67x88=5896 dies, 67x80=5360 and 131x40=5240 ok).  Cap
+    both at 5120 — under every observed-good point with margin — using
+    the larger (backward) leading dim N+M+3."""
+    table = (n + m + 3) * (n + 2)
+    bt = min(_VMEM_TABLE_BUDGET // (3 * table), 128) // 8 * 8
+    return min(bt, 5120 // (n + m + 3) // 8 * 8)
+
+
+def _table_fits_vmem(n: int, m: int) -> bool:
+    return _batch_tile(n, m) >= 8
+
+
+def _pad_batch(x: jax.Array, bt: int) -> jax.Array:
+    bsz = x.shape[0]
+    pad = (-bsz) % bt
+    return x if pad == 0 else jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1))
+
+
+def _tile_for_batch(bsz: int, n: int, m: int) -> int:
+    """The batch tile the single-shot kernels use for an actual batch:
+    VMEM/Mosaic-capped, never padding a tiny batch up to a full tile."""
+    bt = _batch_tile(n, m)
+    assert bt >= 8, (f"soft-DTW tables for N={n}, M={m} exceed the Pallas "
+                     "VMEM budget; use the chunked/scan long-sequence path")
+    return min(bt, -(-bsz // 8) * 8)
+
+
+def _run_forward(d_skew: jax.Array, n: int, m: int, gamma: float,
+                 bandwidth: int):
+    """d_skew: (B, N+M-1, N) -> (value (B,), r_skew (B, N+M+1, N+1))."""
+    bsz = d_skew.shape[0]
+    bt = _tile_for_batch(bsz, n, m)
+    d3 = _pad_batch(d_skew, bt).transpose(1, 0, 2)   # diag-leading
+    bp = d3.shape[1]
+    kernel = functools.partial(_fwd_kernel, n=n, m=m, gamma=gamma,
+                               bandwidth=bandwidth, bt=bt)
+    r3 = pl.pallas_call(
+        kernel,
+        grid=(bp // bt,),
+        in_specs=[pl.BlockSpec((n + m - 1, bt, n), lambda b: (0, b, 0))],
+        out_specs=pl.BlockSpec((n + m + 1, bt, n + 1), lambda b: (0, b, 0)),
+        out_shape=jax.ShapeDtypeStruct((n + m + 1, bp, n + 1), jnp.float32),
+        interpret=_interpret(),
+    )(d3)
+    r_skew = r3.transpose(1, 0, 2)[:bsz]
+    return r_skew[:, n + m, n], r_skew
+
+
 def _run_forward_chunked(d_skew: jax.Array, n: int, m: int, gamma: float,
-                         bandwidth: int, chunk: int):
+                         bandwidth: int, chunk: int | None = None):
     """d_skew: (B, N+M-1, N) -> (value (B,), r_skew (B, N+M+1, N+1))."""
     import math
 
     bsz = d_skew.shape[0]
+    bt = 8
+    if chunk is None:
+        # chunk is the untiled leading dim, so a floor of 1 is legal; never
+        # let the floor push the block past the VMEM budget at huge N
+        chunk = max(1, min(512, _CHUNK_VMEM_ELEMS // (bt * (2 * n + 1))))
     n_diag = n + m - 1                    # diagonals 2..n+m
     n_chunks = math.ceil(n_diag / chunk)
     pad_p = n_chunks * chunk - n_diag
-    d_pad = jnp.pad(d_skew, ((0, 0), (0, pad_p), (0, 0)))
+    d3 = jnp.pad(_pad_batch(d_skew, bt),
+                 ((0, 0), (0, pad_p), (0, 0))).transpose(1, 0, 2)
+    bp = d3.shape[1]
     kernel = functools.partial(_fwd_kernel_chunked, n=n, m=m, gamma=gamma,
-                               bandwidth=bandwidth, chunk=chunk)
-    value, r_body = pl.pallas_call(
+                               bandwidth=bandwidth, chunk=chunk, bt=bt)
+    r3 = pl.pallas_call(
         kernel,
-        grid=(bsz, n_chunks),
-        in_specs=[pl.BlockSpec((1, chunk, n), lambda b, c: (b, c, 0))],
-        out_specs=[pl.BlockSpec((1, 1), lambda b, c: (b, 0)),
-                   pl.BlockSpec((1, chunk, n + 1), lambda b, c: (b, c, 0))],
-        out_shape=[jax.ShapeDtypeStruct((bsz, 1), jnp.float32),
-                   jax.ShapeDtypeStruct((bsz, n_chunks * chunk, n + 1),
-                                        jnp.float32)],
-        scratch_shapes=[pltpu.VMEM((2, n + 1), jnp.float32)],
+        grid=(bp // bt, n_chunks),
+        in_specs=[pl.BlockSpec((chunk, bt, n), lambda b, c: (c, b, 0))],
+        out_specs=pl.BlockSpec((chunk, bt, n + 1), lambda b, c: (c, b, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_chunks * chunk, bp, n + 1),
+                                       jnp.float32),
+        scratch_shapes=[pltpu.VMEM((2, bt, n + 1), jnp.float32)],
         interpret=_interpret(),
-    )(d_pad)
+    )(d3)
+    r_body = r3.transpose(1, 0, 2)[:bsz, :n_diag]
     # re-attach the constant diagonals 0 and 1
     diag0 = jnp.where(jnp.arange(n + 1) == 0, 0.0, BIG)
     head = jnp.stack([diag0, jnp.full((n + 1,), BIG)], axis=0)
     head = jnp.broadcast_to(head[None], (bsz, 2, n + 1))
-    r_skew = jnp.concatenate([head, r_body[:, :n_diag]], axis=1)
-    return value[:, 0], r_skew
+    r_skew = jnp.concatenate([head, r_body], axis=1)
+    return r_skew[:, n + m, n], r_skew
 
 
 def _softdtw_bwd_scan(r_ext: jax.Array, d_ext_skew: jax.Array, n: int,
@@ -225,66 +305,21 @@ def _softdtw_bwd_scan(r_ext: jax.Array, d_ext_skew: jax.Array, n: int,
     return e_skew
 
 
-# Largest (N+M+3) x (N+2) f32 table we let the single-block kernels hold in
-# VMEM (~16 MB/core, leave headroom for D and E).
-_VMEM_TABLE_BUDGET = 2_000_000  # floats
-
-
-def _batch_tile(bsz: int, n: int, m: int) -> int:
-    """Elements per block: as many as keep the block's WHOLE resident set
-    inside the VMEM budget, capped at 128 sublane-friendly elements.
-
-    The backward block is the high-water mark — THREE (N+M+3)x(N+2)
-    tables per element (r/d/e refs; forward holds two) — so the budget
-    divides by 3x the table size: a tile the backward can hold, the
-    forward holds with headroom for Pallas double-buffering."""
-    table = (n + m + 3) * (n + 2)
-    return max(1, min(bsz, _VMEM_TABLE_BUDGET // (3 * table), 128))
-
-
-def _pad_batch(x: jax.Array, bt: int) -> jax.Array:
-    bsz = x.shape[0]
-    pad = (-bsz) % bt
-    return x if pad == 0 else jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1))
-
-
-def _run_forward(d_skew: jax.Array, n: int, m: int, gamma: float,
-                 bandwidth: int):
-    bsz = d_skew.shape[0]
-    bt = _batch_tile(bsz, n, m)
-    d_pad = _pad_batch(d_skew, bt)
-    kernel = functools.partial(_fwd_kernel, n=n, m=m, gamma=gamma,
-                               bandwidth=bandwidth, bt=bt)
-    grid = (d_pad.shape[0] // bt,)
-    value, r_skew = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[pl.BlockSpec((bt, n + m - 1, n), lambda b: (b, 0, 0))],
-        out_specs=[pl.BlockSpec((bt, 1), lambda b: (b, 0)),
-                   pl.BlockSpec((bt, n + m + 1, n + 1), lambda b: (b, 0, 0))],
-        out_shape=[jax.ShapeDtypeStruct((d_pad.shape[0], 1), jnp.float32),
-                   jax.ShapeDtypeStruct((d_pad.shape[0], n + m + 1, n + 1),
-                                        jnp.float32)],
-        interpret=_interpret(),
-    )(d_pad)
-    return value[:bsz, 0], r_skew[:bsz]
-
-
 # --------------------------------------------------------------- backward
 def _bwd_kernel(r_ref, d_ref, e_ref, *, n: int, m: int, gamma: float,
                 bandwidth: int, bt: int):
     """Reverse wavefront over padded-extended coords i in [0,N+1],
-    j in [0,M+1] (diag q = i+j in [0, N+M+2]), skewed layout, a tile of
-    ``bt`` batch elements per block (see _fwd_kernel on why).
-    r_ref/d_ref/e_ref: (bt, N+M+3, N+2)."""
+    j in [0,M+1] (diag q = i+j in [0, N+M+2]), skewed diagonal-leading
+    layout, a tile of ``bt`` pairs per block (see _fwd_kernel on why).
+    r_ref/d_ref/e_ref: (N+M+3, bt, N+2)."""
     n2 = n + 2
     i_buf = lax.broadcasted_iota(jnp.int32, (bt, n2), 1)
     inv_gamma = 1.0 / gamma
 
-    e_ref[:, :, :] = jnp.zeros((bt, n + m + 3, n2), jnp.float32)
+    e_ref[...] = jnp.zeros((n + m + 3, bt, n2), jnp.float32)
     # E[N+1, M+1] = 1 (corner seed, soft_dtw_cuda.py:166-167)
     corner = (i_buf == n + 1).astype(jnp.float32)
-    e_ref[:, n + m + 2, :] = corner
+    e_ref[n + m + 2] = corner
 
     def shift_left(row):                            # row[i] -> row[i+1]
         return jnp.concatenate(
@@ -292,13 +327,13 @@ def _bwd_kernel(r_ref, d_ref, e_ref, *, n: int, m: int, gamma: float,
 
     def body(k, _):
         q = n + m + 2 - k
-        r_q = r_ref[:, q, :]                        # R[i, q-i]: (bt, N+2)
-        r_q1 = r_ref[:, q + 1, :]                   # diag q+1
-        r_q2 = r_ref[:, q + 2, :]                   # diag q+2
-        d_q1 = d_ref[:, q + 1, :]
-        d_q2 = d_ref[:, q + 2, :]
-        e_q1 = e_ref[:, q + 1, :]
-        e_q2 = e_ref[:, q + 2, :]
+        r_q = r_ref[q]                              # R[i, q-i]: (bt, N+2)
+        r_q1 = r_ref[q + 1]                         # diag q+1
+        r_q2 = r_ref[q + 2]                         # diag q+2
+        d_q1 = d_ref[q + 1]
+        d_q2 = d_ref[q + 2]
+        e_q1 = e_ref[q + 1]
+        e_q2 = e_ref[q + 2]
 
         r_up = shift_left(r_q1)                     # R[i+1, j]
         r_left = r_q1                               # R[i, j+1]
@@ -320,7 +355,7 @@ def _bwd_kernel(r_ref, d_ref, e_ref, *, n: int, m: int, gamma: float,
                  & (r_q > -BIG / 2))                # unreached cells -> 0
         if bandwidth > 0:
             valid &= jnp.abs(i_buf - j_buf) <= bandwidth
-        e_ref[:, q, :] = jnp.where(valid, e_row, 0.0)
+        e_ref[q] = jnp.where(valid, e_row, 0.0)
         return 0
 
     # Start at q = n+m (k=2): diagonal n+m+1 holds no valid cell (j would
@@ -331,22 +366,22 @@ def _bwd_kernel(r_ref, d_ref, e_ref, *, n: int, m: int, gamma: float,
 def _run_backward(r_ext_skew: jax.Array, d_ext_skew: jax.Array, n: int,
                   m: int, gamma: float, bandwidth: int) -> jax.Array:
     bsz = r_ext_skew.shape[0]
-    bt = _batch_tile(bsz, n, m)
-    r_pad = _pad_batch(r_ext_skew, bt)
-    d_pad = _pad_batch(d_ext_skew, bt)
+    bt = _tile_for_batch(bsz, n, m)
+    r3 = _pad_batch(r_ext_skew, bt).transpose(1, 0, 2)
+    d3 = _pad_batch(d_ext_skew, bt).transpose(1, 0, 2)
+    bp = r3.shape[1]
     kernel = functools.partial(_bwd_kernel, n=n, m=m, gamma=gamma,
                                bandwidth=bandwidth, bt=bt)
-    spec = pl.BlockSpec((bt, n + m + 3, n + 2), lambda b: (b, 0, 0))
+    spec = pl.BlockSpec((n + m + 3, bt, n + 2), lambda b: (0, b, 0))
     out = pl.pallas_call(
         kernel,
-        grid=(r_pad.shape[0] // bt,),
+        grid=(bp // bt,),
         in_specs=[spec, spec],
         out_specs=spec,
-        out_shape=jax.ShapeDtypeStruct((r_pad.shape[0], n + m + 3, n + 2),
-                                       jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((n + m + 3, bp, n + 2), jnp.float32),
         interpret=_interpret(),
-    )(r_pad, d_pad)
-    return out[:bsz]
+    )(r3, d3)
+    return out.transpose(1, 0, 2)[:bsz]
 
 
 # ----------------------------------------------------------- custom VJP
@@ -358,10 +393,6 @@ def softdtw_pallas(D: jax.Array, gamma: float = 1.0,
     return value
 
 
-def _table_fits_vmem(n: int, m: int) -> bool:
-    return (n + m + 3) * (n + 2) <= _VMEM_TABLE_BUDGET
-
-
 def _softdtw_pallas_fwd(D, gamma, bandwidth):
     _, n, m = D.shape
     d_skew = skew_cost(D.astype(jnp.float32))
@@ -370,9 +401,8 @@ def _softdtw_pallas_fwd(D, gamma, bandwidth):
                                      int(bandwidth))
     else:
         # long-sequence path: stream diagonals in chunks
-        chunk = max(8, _VMEM_TABLE_BUDGET // (4 * (n + 1)))
         value, r_skew = _run_forward_chunked(d_skew, n, m, float(gamma),
-                                             int(bandwidth), chunk)
+                                             int(bandwidth))
     return value, (D, r_skew)
 
 

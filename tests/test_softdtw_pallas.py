@@ -77,3 +77,29 @@ def test_batch_tiling_pads_and_slices():
     want = jax.grad(lambda d: softdtw_scan(d, 0.5).sum())(D)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-3, atol=1e-5)
+
+
+def test_profile_harness_smoke():
+    """The timing+allclose harness (the reference's only self-check,
+    soft_dtw_cuda.py:389-463) runs end-to-end and reports agreement."""
+    from milnce_tpu.ops.softdtw_profile import profile
+
+    rec = profile(4, 5, 6, 3, n_iters=4)
+    assert rec["allclose"] is True
+    assert rec["shape"] == [4, 5, 6, 3]
+    assert rec["scan_fwd_ms"] >= 0.0 and rec["pallas_fwd_ms"] >= 0.0
+
+
+def test_mil_regime_batch_squared_pairs():
+    """The SDTW_3 training regime: B^2 short pairs (32x32 alignment, the
+    shape that crashed Mosaic's vector lowering before the batch-tile
+    cap; see _batch_tile)."""
+    rng = np.random.RandomState(7)
+    D = jnp.asarray(rng.rand(64, 32, 32).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(softdtw_pallas(D, 1.0)),
+                               np.asarray(softdtw_scan(D, 1.0)),
+                               rtol=1e-4, atol=1e-4)
+    got = jax.grad(lambda d: softdtw_pallas(d, 1.0).sum())(D)
+    want = jax.grad(lambda d: softdtw_scan(d, 1.0).sum())(D)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-3, atol=1e-4)
