@@ -73,8 +73,11 @@ class LossConfig:
     """Loss selection + hyperparams (reference: loss.py)."""
 
     name: str = "milnce"                # milnce | cdtw | sdtw_cidm | sdtw_negative | sdtw_3
-    sdtw_backend: str = "scan"          # scan | pallas (TPU wavefront kernel;
-                                        # reference always ran CUDA, loss.py:26-97)
+    sdtw_backend: str = "auto"          # auto | scan | pallas; auto picks the
+                                        # TPU wavefront kernel when the batch
+                                        # fits one VMEM block, scan otherwise
+                                        # (BENCH_SOFTDTW.md; reference always
+                                        # ran CUDA, loss.py:26-97)
     sdtw_gamma: float = 0.1             # loss.py:38,74,97 (cdtw uses 1e-5, loss.py:26)
     sdtw_dist: str = "cosine"           # cosine | negative_dot | negative_cosine | euclidean
     sdtw_bandwidth: int = 0             # Sakoe-Chiba band; 0 = off

@@ -27,7 +27,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-BIG = 1e10  # finite stand-in for +inf: keeps softmin AD NaN-free
+# Finite stand-in for +inf: keeps softmin AD NaN-free.  Must dominate any
+# real path cost — exp(euclidean) costs on raw d=512 gaussian features
+# reach ~1e13 per cell (~1e16 per path), which overran the previous 1e10
+# sentinel and corrupted the backward's r >= BIG/2 invalid-cell test
+# (caught by the TPU profile harness at the reference's 32x256x256x512
+# preset).  1e30 leaves 13 orders of magnitude of headroom and is exactly
+# representable in both f32 and bf16 exponent range.
+BIG = 1e30
 
 
 def skew_cost(D: jax.Array, n_diags: int | None = None,
@@ -152,8 +159,12 @@ class SoftDTW:
     distance function + optional normalization + batched soft-DTW.
 
     ``backend='scan'`` uses this module's lax.scan DP; ``backend='pallas'``
-    uses the TPU wavefront kernel (same math, kernel-resident diagonals).
-    """
+    uses the TPU wavefront kernel (same math, kernel-resident diagonals);
+    ``backend='auto'`` picks per cost-matrix shape: the kernel when the
+    whole (padded) batch fits a single VMEM block — measured ~3x faster
+    than the scan there on v5e — and the scan otherwise, where re-running
+    the diagonal loop per batch tile makes the kernel lose to one scan
+    over the full batch (BENCH_SOFTDTW.md)."""
 
     def __init__(self, gamma: float = 1.0, normalize: bool = False,
                  bandwidth: int | None = None, dist_func: str = "euclidean",
@@ -162,11 +173,21 @@ class SoftDTW:
         self.normalize = normalize
         self.bandwidth = 0 if bandwidth is None else int(bandwidth)
         self.dist_func = DIST_FUNCS[dist_func]
+        if backend not in ("scan", "pallas", "auto"):
+            raise ValueError(f"unknown soft-DTW backend {backend!r}")
+        self.backend = backend
+
+    def _dp(self, D: jax.Array) -> jax.Array:
+        backend = self.backend
+        if backend == "auto":
+            from milnce_tpu.ops.softdtw_pallas import fits_one_block
+
+            backend = "pallas" if fits_one_block(*D.shape) else "scan"
         if backend == "pallas":
             from milnce_tpu.ops.softdtw_pallas import softdtw_pallas
-            self._dp = lambda D: softdtw_pallas(D, self.gamma, self.bandwidth)
-        else:
-            self._dp = lambda D: softdtw_scan(D, self.gamma, self.bandwidth)
+
+            return softdtw_pallas(D, self.gamma, self.bandwidth)
+        return softdtw_scan(D, self.gamma, self.bandwidth)
 
     def __call__(self, x: jax.Array, y: jax.Array) -> jax.Array:
         """x: (B, N, D), y: (B, M, D) -> (B,) alignment costs."""

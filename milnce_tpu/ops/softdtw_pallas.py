@@ -37,7 +37,8 @@ wiring):
 On non-TPU backends the kernel runs in Pallas interpret mode, so the
 same code path is unit-testable on CPU.  All three variants (in-VMEM,
 chunked, backward) lower through Mosaic and run compiled on real TPU
-(verified on v5e; see BENCH_NOTES.md for timings).
+(verified on v5e; see BENCH_SOFTDTW.md for timings and the lowering
+rules the layout was bought with).
 """
 
 from __future__ import annotations
@@ -196,6 +197,15 @@ def _tile_for_batch(bsz: int, n: int, m: int) -> int:
     assert bt >= 8, (f"soft-DTW tables for N={n}, M={m} exceed the Pallas "
                      "VMEM budget; use the chunked/scan long-sequence path")
     return min(bt, -(-bsz // 8) * 8)
+
+
+def fits_one_block(bsz: int, n: int, m: int) -> bool:
+    """True when the whole padded batch runs as a SINGLE kernel block —
+    the regime where the wavefront kernel beats the scan (~3x on v5e;
+    BENCH_SOFTDTW.md).  Multi-block grids re-run the diagonal loop per
+    tile and lose to one scan over the full batch."""
+    bt = _batch_tile(n, m)
+    return bt >= 8 and -(-bsz // 8) * 8 <= bt
 
 
 def _run_forward(d_skew: jax.Array, n: int, m: int, gamma: float,

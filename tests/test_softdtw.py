@@ -140,3 +140,33 @@ def test_no_length_cap():
     D = jnp.ones((1, 1100, 8), jnp.float32)
     out = softdtw_scan(D, 1.0)
     assert np.isfinite(float(out[0]))
+
+
+def test_auto_backend_dispatch():
+    """backend='auto' picks the kernel for one-block batches and the scan
+    for large batches / long sequences; both must agree with the scan."""
+    from milnce_tpu.ops.softdtw import SoftDTW
+
+    from milnce_tpu.ops.softdtw_pallas import _batch_tile, fits_one_block
+
+    rng = np.random.RandomState(11)
+    x = jnp.asarray(rng.randn(4, 10, 6).astype(np.float32))
+    y = jnp.asarray(rng.randn(4, 8, 6).astype(np.float32))
+    assert fits_one_block(4, 10, 8)            # -> pallas arm
+    want = np.asarray(SoftDTW(gamma=0.5, dist_func="cosine")(x, y))
+    got = np.asarray(SoftDTW(gamma=0.5, dist_func="cosine",
+                             backend="auto")(x, y))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    # scan arm: batch beyond one tile must dispatch to the scan and agree
+    big = _batch_tile(10, 8) + 8
+    xb = jnp.asarray(rng.randn(big, 10, 6).astype(np.float32))
+    yb = jnp.asarray(rng.randn(big, 8, 6).astype(np.float32))
+    assert not fits_one_block(big, 10, 8)
+    want_b = np.asarray(SoftDTW(gamma=0.5, dist_func="cosine")(xb, yb))
+    got_b = np.asarray(SoftDTW(gamma=0.5, dist_func="cosine",
+                               backend="auto")(xb, yb))
+    np.testing.assert_allclose(got_b, want_b, rtol=1e-5, atol=1e-6)
+
+    with np.testing.assert_raises(Exception):
+        SoftDTW(backend="cuda")  # the reference's backend name is invalid
