@@ -27,7 +27,7 @@ import time
 import numpy as np
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
-_CPU_CHILD_FLAG = "MILNCE_BENCH_CPU_CHILD"
+_CHILD_MODE_ENV = "MILNCE_BENCH_CHILD_MODE"  # "cpu" | "tpu"
 
 # clips/sec/chip anchor for vs_baseline: the first recorded real-TPU
 # operating point (round-2 session, v5e, bfloat16 batch 256 @16f/224 —
@@ -68,15 +68,20 @@ def _peak_flops(device_kind: str):
 
 
 def _probe_backend(timeout_s: float = 180.0) -> bool:
-    """Initialize the accelerator backend in a THROWAWAY subprocess first.
+    """Initialize the accelerator backend AND run one tiny jitted execute
+    in a THROWAWAY subprocess first.
 
-    Two observed failure modes of the TPU tunnel make in-process init
-    unsafe: it can raise UNAVAILABLE (the round-1 bench crash), and it
-    can HANG indefinitely (observed when a previous client died
-    mid-connect) — a hang in the main process would eat the driver's
-    whole gate timeout with no JSON emitted.  A subprocess probe converts
-    both into a clean boolean."""
-    code = "import jax; print(len(jax.devices()))"
+    Three observed failure modes of the TPU tunnel make in-process use
+    unsafe: init can raise UNAVAILABLE (the round-1 bench crash), init
+    can HANG (a previous client died mid-connect), and — nastiest —
+    init can SUCCEED while the first compile/execute hangs forever (a
+    previous client was killed mid-execute; observed 2026-07-30, the
+    compile-helper ports refuse connections).  A hang in the main
+    process would eat the driver's whole gate timeout with no JSON
+    emitted; probing with a real execute converts all three into a
+    clean boolean."""
+    code = ("import jax, jax.numpy as jnp; "
+            "print(float(jax.jit(lambda: jnp.ones(4).sum())()))")
     try:
         proc = subprocess.run([sys.executable, "-c", code],
                               capture_output=True, timeout=timeout_s)
@@ -286,9 +291,14 @@ def run_bench(on_tpu: bool):
         "value": value,
         "unit": "clips/sec/chip",
         # ratio vs the recorded TPU anchor — only meaningful on TPU (a
-        # CPU-fallback number against a TPU anchor would be noise)
+        # CPU-fallback number against a TPU anchor would be noise).  The
+        # 95.35 anchor predates differenced timing, which removed ~20%
+        # of tunnel latency from the reading — part of any ratio > 1 is
+        # that method change, flagged until the anchor is re-measured.
         "vs_baseline": (round(value / BASELINE_THROUGHPUT, 3)
                         if BASELINE_THROUGHPUT and on_tpu else 1.0),
+        "timing": "differenced",
+        "anchor_timing": "latency-inclusive (pre-differencing)",
         "on_tpu": on_tpu,
         "device_kind": str(kind),
     }
@@ -334,39 +344,82 @@ def main():
         except Exception:
             pass
 
-        def run_cpu_child():
-            _note("bench: accelerator unavailable; re-exec on CPU")
+        mode = os.environ.get(_CHILD_MODE_ENV)
+        if mode in ("cpu", "tpu"):
+            # Child: measure and print the record to stdout (captured by
+            # the parent, which is the single emitter).  On ANY failure
+            # exit nonzero with no record — the parent falls back; a
+            # swallowed 0.0 record here would mask a working CPU path.
+            try:
+                if mode == "cpu":
+                    jax.config.update("jax_platforms", "cpu")
+                devices = _devices()
+                on_tpu = (mode == "tpu" and
+                          any(d.platform in ("tpu", "axon") for d in devices))
+                _emit(run_bench(on_tpu))
+                return
+            except Exception as exc:
+                _note(f"bench child[{mode}]: {type(exc).__name__}: {exc}")
+                sys.exit(1)
+
+        # Parent: orchestrate the measurement in CHILDREN so no tunnel
+        # failure mode — crash, hang at init, or hang at first execute
+        # (all three observed) — can eat the driver's gate timeout
+        # without a JSON line being printed.  Child stdout is captured
+        # and the LAST parsable JSON line forwarded, so exactly one
+        # record ever reaches the driver.
+        def last_json(raw: bytes):
+            for line in reversed(raw.decode(errors="replace").splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        return json.loads(line)
+                    except Exception:
+                        pass
+            return None
+
+        def run_child(child_mode: str, timeout=None):
             env = dict(os.environ)
-            env[_CPU_CHILD_FLAG] = "1"
-            env["JAX_PLATFORMS"] = "cpu"
-            proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                                  env=env, cwd=_REPO)
-            if proc.returncode != 0:
-                raise RuntimeError(f"CPU fallback child rc={proc.returncode}")
+            env[_CHILD_MODE_ENV] = child_mode
+            if child_mode == "cpu":
+                env["JAX_PLATFORMS"] = "cpu"
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, cwd=_REPO, stdout=subprocess.PIPE)
+            try:
+                out, _ = proc.communicate(timeout=timeout)
+                status = "ok" if proc.returncode == 0 else f"rc={proc.returncode}"
+            except subprocess.TimeoutExpired:
+                # SIGTERM first with a grace period: a hard kill of a live
+                # TPU client is what wedges the relay (SKILL.md notes);
+                # only escalate if the client ignores the term.
+                proc.terminate()
+                try:
+                    out, _ = proc.communicate(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    out, _ = proc.communicate()
+                status = f"timeout>{timeout}s"
+            return last_json(out or b""), status
 
-        if os.environ.get(_CPU_CHILD_FLAG) == "1":
-            jax.config.update("jax_platforms", "cpu")
-        elif not _probe_backend():
-            # Accelerator init would crash or HANG this process — run the
-            # whole bench on CPU in a child so the driver still gets its
-            # JSON line.  (The probe costs one duplicate backend init on
-            # the healthy path — accepted: it is the only guard against
-            # the hang mode, which no in-process try/except can catch.)
-            run_cpu_child()
-            return
-
-        try:
-            devices = _devices()
-        except Exception:
-            # Probe succeeded but the tunnel flaked between probe and real
-            # init (UNAVAILABLE is intermittent) — still recover on CPU.
-            if os.environ.get(_CPU_CHILD_FLAG) == "1":
-                raise
-            run_cpu_child()
-            return
-
-        on_tpu = any(d.platform in ("tpu", "axon") for d in devices)
-        _emit(run_bench(on_tpu))
+        if _probe_backend():
+            # Even a healthy-probing tunnel can wedge mid-sweep; bound the
+            # whole TPU run and fall back rather than hang the gate.
+            budget = float(os.environ.get("MILNCE_BENCH_TPU_TIMEOUT", "2400"))
+            rec, status = run_child("tpu", timeout=budget)
+            if rec is not None:
+                if status != "ok":
+                    _note(f"bench: TPU child {status}; forwarding the record "
+                          "it emitted before dying")
+                _emit(rec)
+                return
+            _note(f"bench: TPU child {status} with no record — CPU fallback")
+        else:
+            _note("bench: accelerator unavailable; re-exec on CPU")
+        rec, status = run_child("cpu")
+        if rec is None:
+            raise RuntimeError(f"CPU fallback child {status} with no record")
+        _emit(rec)
     except Exception as exc:  # LAST RESORT: the line must always be parsable
         _emit({"metric": "train_step clips/sec/chip", "value": 0.0,
                "unit": "clips/sec/chip", "vs_baseline": 0.0,
