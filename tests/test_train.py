@@ -270,3 +270,52 @@ def test_loss_decreases_when_overfitting_one_batch():
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
     assert all(np.isfinite(l) for l in losses), losses
+
+
+def test_train_step_on_two_axis_mesh():
+    """SURVEY §2.3: TP isn't needed for S3D, but the mesh must be READY
+    for a model axis — the identical train step has to compile and match
+    the 1-D result on a (data x model) mesh with params replicated."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from milnce_tpu.config import OptimConfig, ParallelConfig
+    from milnce_tpu.models import S3D
+    from milnce_tpu.parallel.mesh import build_mesh
+    from milnce_tpu.train.schedule import build_schedule
+    from milnce_tpu.train.state import build_optimizer, create_train_state
+    from milnce_tpu.train.step import make_train_step
+
+    # sync BN: local per-shard BN stats would legitimately differ between
+    # an 8x1 and a 4x2 sharding of the same batch; cross-replica BN over
+    # 'data' normalizes with GLOBAL batch stats on both meshes.
+    model = S3D(num_classes=16, vocab_size=32, word_embedding_dim=8,
+                text_hidden_dim=16, inception_blocks=1, dtype=jnp.float32,
+                bn_axis_name="data")
+    rng = np.random.RandomState(3)
+    b, k = 8, 2
+    video = rng.randint(0, 255, (b, 4, 16, 16, 3), np.uint8)
+    text = rng.randint(0, 32, (b * k, 5)).astype(np.int32)
+    start = np.zeros((b,), np.float32)
+
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, 4, 16, 16, 3), jnp.float32),
+                           jnp.zeros((2 * k, 5), jnp.int32))
+    optim_cfg = OptimConfig(warmup_steps=2)
+
+    def one_step(mesh):
+        opt = build_optimizer(optim_cfg, build_schedule(optim_cfg, 10))
+        state = create_train_state(variables, opt)
+        step = make_train_step(model, opt, mesh, donate=False)
+        sh = NamedSharding(mesh, P("data"))
+        _, loss = step(state, jax.device_put(video, sh),
+                       jax.device_put(text, sh), jax.device_put(start, sh))
+        return float(loss)
+
+    mesh_1d = build_mesh(ParallelConfig())
+    mesh_2d = build_mesh(ParallelConfig(model_axis="model",
+                                        model_parallel_size=2))
+    assert mesh_2d.devices.shape == (4, 2)
+    np.testing.assert_allclose(one_step(mesh_2d), one_step(mesh_1d),
+                               rtol=1e-5)
