@@ -44,6 +44,7 @@ rules the layout was bought with).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -161,6 +162,11 @@ _VMEM_TABLE_BUDGET = 1_200_000
 
 _CHUNK_VMEM_ELEMS = 500_000  # chunked-path block budget (d+r, dbl-buffered)
 
+# Empirical Mosaic vector-lowering cap on (leading-dim x sublane) block
+# area, bisected on v5e libtpu 2026-07 (see _batch_tile docstring); both
+# kernel layouts must respect it.
+_MOSAIC_BLOCK_AREA_CAP = 5120
+
 
 def _batch_tile(n: int, m: int) -> int:
     """Pairs per block, multiple of 8 (Mosaic sublane tiling), capped at
@@ -177,7 +183,7 @@ def _batch_tile(n: int, m: int) -> int:
     the larger (backward) leading dim N+M+3."""
     table = (n + m + 3) * (n + 2)
     bt = min(_VMEM_TABLE_BUDGET // (3 * table), 128) // 8 * 8
-    return min(bt, 5120 // (n + m + 3) // 8 * 8)
+    return min(bt, _MOSAIC_BLOCK_AREA_CAP // (n + m + 3) // 8 * 8)
 
 
 def _table_fits_vmem(n: int, m: int) -> bool:
@@ -227,6 +233,159 @@ def _run_forward(d_skew: jax.Array, n: int, m: int, gamma: float,
     )(d3)
     r_skew = r3.transpose(1, 0, 2)[:bsz]
     return r_skew[:, n + m, n], r_skew
+
+
+# -------------------------------------------------- batch-on-lanes layout
+# Experimental alternative single-shot layout for LARGE batches of SHORT
+# pairs (the SDTW_3 B^2 regime): refs are (diagonals, N+1, batch_lanes),
+# i.e. the alignment index lives on SUBLANES and batch fills the 128-wide
+# LANE dimension.  Per wavefront step this touches ceil((N+1)/8) vector
+# tiles instead of ceil(bt/8) — for batch >> N+1 that is up to n1/128 of
+# the sublane-batch layout's total tile traffic.  Gated behind
+# MILNCE_SDTW_LANES=1 until measured compiled on TPU (the sublane layout's
+# Mosaic area cap is assumed to transfer; see _batch_tile).
+
+
+def _lane_tile(bsz: int) -> int:
+    """Lanes per block: one full-lane block (<=128, lane dim equal to
+    the array's) or 128-lane blocks over a padded batch."""
+    return bsz if bsz <= 128 else 128
+
+
+def _use_lanes(bsz: int, n: int, m: int) -> bool:
+    if os.environ.get("MILNCE_SDTW_LANES") != "1":
+        return False
+    area = (n + m + 3) * (n + 2)
+    bl = _lane_tile(bsz)
+    return (area <= _MOSAIC_BLOCK_AREA_CAP
+            and 3 * area * bl <= _VMEM_TABLE_BUDGET
+            and bsz > n + 1)
+
+
+def _lanes_pad(x: jax.Array):
+    bl = _lane_tile(x.shape[0])
+    return _pad_batch(x, bl), bl
+
+
+def _fwd_kernel_lanes(d_ref, r_ref, *, n: int, m: int, gamma: float,
+                      bandwidth: int, bl: int):
+    """d_ref: (N+M-1, N, bl); r_ref: (N+M+1, N+1, bl).  Same recurrence
+    as _fwd_kernel with i on sublanes and batch on lanes."""
+    n1 = n + 1
+    i_buf = lax.broadcasted_iota(jnp.int32, (n1, bl), 0)
+
+    r_ref[0] = jnp.where(i_buf == 0, 0.0, BIG)
+    r_ref[1] = jnp.full((n1, bl), BIG, jnp.float32)
+
+    inv_gamma = 1.0 / gamma
+
+    def body(p, _):
+        r_mm = r_ref[p - 2]                         # (N+1, bl)
+        r_m = r_ref[p - 1]
+        cost = d_ref[p - 2]                         # (N, bl)
+        prev_diag = r_mm[:-1, :]                    # R[i-1, j-1]
+        prev_up = r_m[:-1, :]                       # R[i-1, j]
+        prev_left = r_m[1:, :]                      # R[i, j-1]
+        n0 = -prev_diag * inv_gamma
+        n1_ = -prev_up * inv_gamma
+        n2 = -prev_left * inv_gamma
+        mx = jnp.maximum(jnp.maximum(n0, n1_), n2)
+        softmin = -gamma * (jnp.log(jnp.exp(n0 - mx) + jnp.exp(n1_ - mx)
+                                    + jnp.exp(n2 - mx)) + mx)
+        row = jnp.concatenate(
+            [jnp.full((1, bl), BIG, jnp.float32), cost + softmin], axis=0)
+        j_buf = p - i_buf
+        valid = ((i_buf >= 1) & (j_buf >= 1) & (j_buf <= m))
+        if bandwidth > 0:
+            valid &= jnp.abs(i_buf - j_buf) <= bandwidth
+        r_ref[p] = jnp.where(valid, row, BIG)
+        return 0
+
+    lax.fori_loop(2, n + m + 1, body, 0)
+
+
+def _run_forward_lanes(d_skew: jax.Array, n: int, m: int, gamma: float,
+                       bandwidth: int):
+    """d_skew: (B, N+M-1, N) -> (value (B,), r_skew (B, N+M+1, N+1))."""
+    bsz = d_skew.shape[0]
+    d_pad, bl = _lanes_pad(d_skew)
+    d3 = d_pad.transpose(1, 2, 0)                    # (S, N, B_pad)
+    bp = d3.shape[2]
+    kernel = functools.partial(_fwd_kernel_lanes, n=n, m=m, gamma=gamma,
+                               bandwidth=bandwidth, bl=bl)
+    r3 = pl.pallas_call(
+        kernel,
+        grid=(bp // bl,),
+        in_specs=[pl.BlockSpec((n + m - 1, n, bl), lambda b: (0, 0, b))],
+        out_specs=pl.BlockSpec((n + m + 1, n + 1, bl), lambda b: (0, 0, b)),
+        out_shape=jax.ShapeDtypeStruct((n + m + 1, n + 1, bp), jnp.float32),
+        interpret=_interpret(),
+    )(d3)
+    r_skew = r3.transpose(2, 0, 1)[:bsz]
+    return r_skew[:, n + m, n], r_skew
+
+
+def _bwd_kernel_lanes(r_ref, d_ref, e_ref, *, n: int, m: int, gamma: float,
+                      bandwidth: int, bl: int):
+    """Reverse wavefront, lanes layout: refs (N+M+3, N+2, bl)."""
+    n2 = n + 2
+    i_buf = lax.broadcasted_iota(jnp.int32, (n2, bl), 0)
+    inv_gamma = 1.0 / gamma
+
+    e_ref[...] = jnp.zeros((n + m + 3, n2, bl), jnp.float32)
+    e_ref[n + m + 2] = (i_buf == n + 1).astype(jnp.float32)
+
+    def shift_up(row):                              # row[i] -> row[i+1]
+        return jnp.concatenate(
+            [row[1:, :], jnp.zeros((1, bl), row.dtype)], axis=0)
+
+    def body(k, _):
+        q = n + m + 2 - k
+        r_q = r_ref[q]                              # (N+2, bl)
+        r_q1 = r_ref[q + 1]
+        r_q2 = r_ref[q + 2]
+        d_q1 = d_ref[q + 1]
+        d_q2 = d_ref[q + 2]
+        e_q1 = e_ref[q + 1]
+        e_q2 = e_ref[q + 2]
+
+        a = jnp.exp((shift_up(r_q1) - r_q - shift_up(d_q1)) * inv_gamma)
+        b_ = jnp.exp((r_q1 - r_q - d_q1) * inv_gamma)
+        c = jnp.exp((shift_up(r_q2) - r_q - shift_up(d_q2)) * inv_gamma)
+        e_row = shift_up(e_q1) * a + e_q1 * b_ + shift_up(e_q2) * c
+
+        j_buf = q - i_buf
+        valid = ((i_buf >= 1) & (i_buf <= n) & (j_buf >= 1) & (j_buf <= m)
+                 & (r_q > -BIG / 2))
+        if bandwidth > 0:
+            valid &= jnp.abs(i_buf - j_buf) <= bandwidth
+        e_ref[q] = jnp.where(valid, e_row, 0.0)
+        return 0
+
+    lax.fori_loop(2, n + m + 1, body, 0)
+
+
+def _run_backward_lanes(r_ext_skew: jax.Array, d_ext_skew: jax.Array,
+                        n: int, m: int, gamma: float,
+                        bandwidth: int) -> jax.Array:
+    bsz = r_ext_skew.shape[0]
+    r_pad, bl = _lanes_pad(r_ext_skew)
+    d_pad, _ = _lanes_pad(d_ext_skew)
+    r3 = r_pad.transpose(1, 2, 0)
+    d3 = d_pad.transpose(1, 2, 0)
+    bp = r3.shape[2]
+    kernel = functools.partial(_bwd_kernel_lanes, n=n, m=m, gamma=gamma,
+                               bandwidth=bandwidth, bl=bl)
+    spec = pl.BlockSpec((n + m + 3, n + 2, bl), lambda b: (0, 0, b))
+    out = pl.pallas_call(
+        kernel,
+        grid=(bp // bl,),
+        in_specs=[spec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((n + m + 3, n + 2, bp), jnp.float32),
+        interpret=_interpret(),
+    )(r3, d3)
+    return out.transpose(2, 0, 1)[:bsz]
 
 
 def _run_forward_chunked(d_skew: jax.Array, n: int, m: int, gamma: float,
@@ -404,9 +563,12 @@ def softdtw_pallas(D: jax.Array, gamma: float = 1.0,
 
 
 def _softdtw_pallas_fwd(D, gamma, bandwidth):
-    _, n, m = D.shape
+    bsz, n, m = D.shape
     d_skew = skew_cost(D.astype(jnp.float32))
-    if _table_fits_vmem(n, m):
+    if _use_lanes(bsz, n, m):
+        value, r_skew = _run_forward_lanes(d_skew, n, m, float(gamma),
+                                           int(bandwidth))
+    elif _table_fits_vmem(n, m):
         value, r_skew = _run_forward(d_skew, n, m, float(gamma),
                                      int(bandwidth))
     else:
@@ -427,7 +589,10 @@ def _softdtw_pallas_bwd(gamma, bandwidth, residuals, grad_out):
     # Padded costs D_[i, j] (zeros border), skewed to match.
     d_ext = jnp.pad(D.astype(jnp.float32), ((0, 0), (1, 1), (1, 1)))
     d_ext_skew = skew_cost(d_ext)                   # (B, N+M+3, N+2)
-    if _table_fits_vmem(n, m):
+    if _use_lanes(bsz, n, m):
+        e_skew = _run_backward_lanes(r_ext, d_ext_skew, n, m, float(gamma),
+                                     int(bandwidth))
+    elif _table_fits_vmem(n, m):
         e_skew = _run_backward(r_ext, d_ext_skew, n, m, float(gamma),
                                int(bandwidth))
     else:

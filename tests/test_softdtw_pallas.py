@@ -103,3 +103,25 @@ def test_mil_regime_batch_squared_pairs():
     want = jax.grad(lambda d: softdtw_scan(d, 1.0).sum())(D)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-3, atol=1e-4)
+
+
+def test_lanes_layout_matches_scan(monkeypatch):
+    """MILNCE_SDTW_LANES=1 routes large-batch short-pair shapes through
+    the batch-on-lanes kernels; values and grads must match the scan
+    (multi-block at B=300, rectangular, and the 32x32 MIL shape)."""
+    monkeypatch.setenv("MILNCE_SDTW_LANES", "1")
+    from milnce_tpu.ops import softdtw_pallas as sp
+
+    rng = np.random.RandomState(13)
+    for (b, n, m) in [(64, 32, 32), (300, 10, 8), (40, 16, 24)]:
+        assert sp._use_lanes(b, n, m)
+        D = jnp.asarray(rng.rand(b, n, m).astype(np.float32))
+        np.testing.assert_allclose(np.asarray(softdtw_pallas(D, 0.7)),
+                                   np.asarray(softdtw_scan(D, 0.7)),
+                                   rtol=1e-4, atol=1e-4)
+        got = jax.grad(lambda d: softdtw_pallas(d, 0.7).sum())(D)
+        want = jax.grad(lambda d: softdtw_scan(d, 0.7).sum())(D)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-3, atol=1e-3)
+    # small batches stay on the sublane-batch layout
+    assert not sp._use_lanes(4, 10, 8)
