@@ -160,11 +160,12 @@ class SoftDTW:
 
     ``backend='scan'`` uses this module's lax.scan DP; ``backend='pallas'``
     uses the TPU wavefront kernel (same math, kernel-resident diagonals);
-    ``backend='auto'`` picks per cost-matrix shape: the kernel when the
-    whole (padded) batch fits a single VMEM block — measured ~3x faster
-    than the scan there on v5e — and the scan otherwise, where re-running
-    the diagonal loop per batch tile makes the kernel lose to one scan
-    over the full batch (BENCH_SOFTDTW.md)."""
+    ``backend='auto'`` picks per cost-matrix shape (v5e measurements,
+    BENCH_SOFTDTW.md): the kernel wherever the batch-on-lanes layout
+    applies (3.5-26x over the scan at large-batch/short-pair shapes) or
+    the whole padded batch fits one sublane-batch VMEM block (~3x); the
+    scan otherwise, where re-running the diagonal loop per batch tile
+    makes the kernel lose to one scan over the full batch."""
 
     def __init__(self, gamma: float = 1.0, normalize: bool = False,
                  bandwidth: int | None = None, dist_func: str = "euclidean",
@@ -180,9 +181,9 @@ class SoftDTW:
     def _dp(self, D: jax.Array) -> jax.Array:
         backend = self.backend
         if backend == "auto":
-            from milnce_tpu.ops.softdtw_pallas import fits_one_block
+            from milnce_tpu.ops.softdtw_pallas import prefers_pallas
 
-            backend = "pallas" if fits_one_block(*D.shape) else "scan"
+            backend = "pallas" if prefers_pallas(*D.shape) else "scan"
         if backend == "pallas":
             from milnce_tpu.ops.softdtw_pallas import softdtw_pallas
 

@@ -236,14 +236,16 @@ def _run_forward(d_skew: jax.Array, n: int, m: int, gamma: float,
 
 
 # -------------------------------------------------- batch-on-lanes layout
-# Experimental alternative single-shot layout for LARGE batches of SHORT
-# pairs (the SDTW_3 B^2 regime): refs are (diagonals, N+1, batch_lanes),
-# i.e. the alignment index lives on SUBLANES and batch fills the 128-wide
-# LANE dimension.  Per wavefront step this touches ceil((N+1)/8) vector
-# tiles instead of ceil(bt/8) — for batch >> N+1 that is up to n1/128 of
-# the sublane-batch layout's total tile traffic.  Gated behind
-# MILNCE_SDTW_LANES=1 until measured compiled on TPU (the sublane layout's
-# Mosaic area cap is assumed to transfer; see _batch_tile).
+# Alternative single-shot layout for LARGE batches of SHORT pairs (the
+# SDTW_3 B^2 regime): refs are (diagonals, N+1, batch_lanes), i.e. the
+# alignment index lives on SUBLANES and batch fills the 128-wide LANE
+# dimension.  Per wavefront step this touches ceil((N+1)/8) vector tiles
+# instead of ceil(bt/8) — for batch >> N+1 that is up to n1/128 of the
+# sublane-batch layout's total tile traffic.  Measured compiled on v5e
+# (BENCH_SOFTDTW.md): 25.8x over the scan at (128, 17, 15) fwd+bwd and
+# 3.5x at (1024, 32, 32) — regimes where the sublane-batch layout LOSES
+# to the scan — so it is the default wherever its shape conditions hold
+# (escape hatch: MILNCE_SDTW_LANES=0).
 
 
 def _lane_tile(bsz: int) -> int:
@@ -253,13 +255,23 @@ def _lane_tile(bsz: int) -> int:
 
 
 def _use_lanes(bsz: int, n: int, m: int) -> bool:
-    if os.environ.get("MILNCE_SDTW_LANES") != "1":
+    if os.environ.get("MILNCE_SDTW_LANES") == "0":
         return False
     area = (n + m + 3) * (n + 2)
     bl = _lane_tile(bsz)
     return (area <= _MOSAIC_BLOCK_AREA_CAP
             and 3 * area * bl <= _VMEM_TABLE_BUDGET
             and bsz > n + 1)
+
+
+def prefers_pallas(bsz: int, n: int, m: int) -> bool:
+    """Shape-dispatch rule for ``SoftDTW(backend='auto')``, from the v5e
+    measurements in BENCH_SOFTDTW.md: the kernel wins wherever the
+    batch-on-lanes layout applies (3.5-26x, any batch size) or the whole
+    padded batch runs as a single sublane-batch block (~3x).  Elsewhere —
+    multi-block sublane grids re-running the diagonal loop per tile —
+    one scan over the full batch wins."""
+    return _use_lanes(bsz, n, m) or fits_one_block(bsz, n, m)
 
 
 def _lanes_pad(x: jax.Array):

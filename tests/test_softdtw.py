@@ -143,11 +143,13 @@ def test_no_length_cap():
 
 
 def test_auto_backend_dispatch():
-    """backend='auto' picks the kernel for one-block batches and the scan
-    for large batches / long sequences; both must agree with the scan."""
+    """backend='auto' picks the kernel wherever a measured-winning layout
+    applies (one-block sublane-batch, or batch-on-lanes at any batch) and
+    the scan elsewhere; both arms must agree with the scan."""
     from milnce_tpu.ops.softdtw import SoftDTW
 
-    from milnce_tpu.ops.softdtw_pallas import _batch_tile, fits_one_block
+    from milnce_tpu.ops.softdtw_pallas import (_batch_tile, fits_one_block,
+                                               prefers_pallas)
 
     rng = np.random.RandomState(11)
     x = jnp.asarray(rng.randn(4, 10, 6).astype(np.float32))
@@ -158,15 +160,26 @@ def test_auto_backend_dispatch():
                              backend="auto")(x, y))
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
-    # scan arm: batch beyond one tile must dispatch to the scan and agree
+    # pallas arm via lanes: batch beyond one sublane tile still routes to
+    # the kernel (batch-on-lanes layout) and must agree
     big = _batch_tile(10, 8) + 8
     xb = jnp.asarray(rng.randn(big, 10, 6).astype(np.float32))
     yb = jnp.asarray(rng.randn(big, 8, 6).astype(np.float32))
-    assert not fits_one_block(big, 10, 8)
+    assert not fits_one_block(big, 10, 8) and prefers_pallas(big, 10, 8)
     want_b = np.asarray(SoftDTW(gamma=0.5, dist_func="cosine")(xb, yb))
     got_b = np.asarray(SoftDTW(gamma=0.5, dist_func="cosine",
                                backend="auto")(xb, yb))
     np.testing.assert_allclose(got_b, want_b, rtol=1e-5, atol=1e-6)
+
+    # scan arm: tables past the Mosaic area cap (long pairs, multi-block
+    # batch) dispatch to the scan and agree
+    assert not prefers_pallas(40, 70, 70)
+    xl = jnp.asarray(rng.randn(40, 70, 6).astype(np.float32))
+    yl = jnp.asarray(rng.randn(40, 70, 6).astype(np.float32))
+    want_l = np.asarray(SoftDTW(gamma=0.5, dist_func="cosine")(xl, yl))
+    got_l = np.asarray(SoftDTW(gamma=0.5, dist_func="cosine",
+                               backend="auto")(xl, yl))
+    np.testing.assert_allclose(got_l, want_l, rtol=1e-5, atol=1e-6)
 
     with np.testing.assert_raises(Exception):
         SoftDTW(backend="cuda")  # the reference's backend name is invalid

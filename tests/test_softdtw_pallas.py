@@ -106,10 +106,10 @@ def test_mil_regime_batch_squared_pairs():
 
 
 def test_lanes_layout_matches_scan(monkeypatch):
-    """MILNCE_SDTW_LANES=1 routes large-batch short-pair shapes through
-    the batch-on-lanes kernels; values and grads must match the scan
-    (multi-block at B=300, rectangular, and the 32x32 MIL shape)."""
-    monkeypatch.setenv("MILNCE_SDTW_LANES", "1")
+    """Large-batch short-pair shapes route through the batch-on-lanes
+    kernels by default (measured 3.5-26x on v5e, BENCH_SOFTDTW.md);
+    values and grads must match the scan (multi-block at B=300,
+    rectangular, and the 32x32 MIL shape)."""
     from milnce_tpu.ops import softdtw_pallas as sp
 
     rng = np.random.RandomState(13)
@@ -125,3 +125,6 @@ def test_lanes_layout_matches_scan(monkeypatch):
                                    rtol=1e-3, atol=1e-3)
     # small batches stay on the sublane-batch layout
     assert not sp._use_lanes(4, 10, 8)
+    # MILNCE_SDTW_LANES=0 is the escape hatch back to sublane-batch
+    monkeypatch.setenv("MILNCE_SDTW_LANES", "0")
+    assert not sp._use_lanes(64, 32, 32)
