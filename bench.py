@@ -4,9 +4,11 @@
 Prints exactly ONE JSON line on stdout:
     {"metric", "value", "unit", "vs_baseline", ...}
 and NEVER exits without printing it — backend init is guarded (retry,
-then CPU-fallback re-exec, then a parsable error record).  Detailed
-sweep results (per-dtype, per-batch, MFU) go to stderr and
-``BENCH_NOTES.md``.
+then CPU-fallback re-exec, then a parsable error record).  Measurement
+children additionally stream an interim best-so-far record after every
+config, so a tunnel hang mid-sweep still surfaces the rows already
+measured (the parent forwards the last parsable line).  Detailed sweep
+results (per-dtype, per-batch, MFU) go to stderr and ``BENCH_NOTES.md``.
 
 The reference publishes no throughput numbers (BASELINE.md: "to be
 established"); the headline metric is the best clips/sec/chip across the
@@ -109,22 +111,36 @@ def _devices():
 
 
 def _step_flops(step_fn, args):
-    """Per-step FLOPs from XLA's cost analysis of the lowered (uncompiled)
-    single-step program — lowering is cheap and, unlike analyzing the
-    inner_steps>1 scan program, counts the whole step exactly once."""
+    """Per-step FLOPs from XLA's cost analysis of the single-step program
+    (unlike analyzing the inner_steps>1 scan program, this counts the
+    whole step exactly once).  The lowered-but-uncompiled analysis is
+    tried first (cheap); some backends (axon tunnel, 2026-07-30) return
+    None from it, so fall back to compiling — the compile is cached and
+    single-step, so the cost is bounded."""
     try:
-        cost = step_fn.lower(*args).cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        return float(cost.get("flops", 0.0)) or None
+        lowered = step_fn.lower(*args)
     except Exception as exc:
-        _note(f"bench: cost_analysis unavailable: {exc}")
+        _note(f"bench: lowering for cost analysis failed: {exc}")
         return None
+    for stage in ("lowered", "compiled"):
+        try:
+            obj = lowered if stage == "lowered" else lowered.compile()
+            cost = obj.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else None
+            if cost:
+                flops = float(cost.get("flops", 0.0))
+                if flops > 0:
+                    return flops
+        except Exception as exc:
+            _note(f"bench: {stage} cost_analysis unavailable: {exc}")
+    return None
 
 
 def _bench_config(dtype: str, batch: int, frames: int, size: int,
                   words: int, k: int, remat: bool,
-                  inner: int = 1, s2d: bool = False):
+                  inner: int = 1, s2d: bool = False,
+                  peak: float | None = None, flops_hint: float | None = None):
     """Time the full train step at one operating point.
 
     ``inner`` optimizer steps run inside ONE XLA program per dispatch
@@ -164,13 +180,19 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
     text_d = jax.device_put(text)
     start_d = jax.device_put(np.zeros((batch,), np.float32))
 
-    single = (step_fn if inner == 1 else
-              make_train_step(model, optimizer, mesh, donate=False))
-    flops = _step_flops(single, (state, video_d, text_d, start_d))
+    if flops_hint is not None:
+        # Model FLOPs are linear in batch at fixed (frames, size, arch):
+        # reuse the plan's first measured config instead of paying another
+        # full-model compile over the tunnel just for the MFU diagnostic.
+        flops = flops_hint
+    else:
+        single = (step_fn if inner == 1 else
+                  make_train_step(model, optimizer, mesh, donate=False))
+        flops = _step_flops(single, (state, video_d, text_d, start_d))
 
     # warmup / compile
     state, loss = step_fn(state, video_d, text_d, start_d)
-    jax.block_until_ready(loss)
+    float(loss)
 
     def wall(n_dispatch: int) -> float:
         nonlocal state
@@ -178,7 +200,12 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
         loss = None
         for _ in range(n_dispatch):
             state, loss = step_fn(state, video_d, text_d, start_d)
-        jax.block_until_ready(loss)
+        # Materialize the scalar ON HOST: over the axon tunnel
+        # block_until_ready can resolve before the device work is
+        # observable (the softdtw_profile harness hit the same thing —
+        # a kernel "measured" at 5 us chained); a device->host transfer
+        # of the computed value cannot.
+        float(loss)
         return time.perf_counter() - t0
 
     # Differenced timing: W(n) = latency + n * device_time when dispatches
@@ -201,6 +228,20 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
         dt = (w2 - w1) / (k2 - k1)         # per-dispatch device time
 
     n_chips = len(jax.devices())
+    if flops:
+        # Physical sanity: implied FLOP/s beyond this device's peak means
+        # the measurement is broken (e.g. a tunnel whose block_until_ready
+        # resolves early — observed 2026-07-30 producing 392k clips/s/chip,
+        # 4000x reality).  Better no row than a fantasy row.  flops counts
+        # the whole sharded step, so scale the bound by chip count; the
+        # fleet-wide max is the fallback when the device kind is unknown.
+        implied = flops * inner / dt
+        bound = 1.5 * (peak or max(_PEAK_FLOPS.values())) * n_chips
+        if implied > bound:
+            raise RuntimeError(
+                f"implausible measurement: {implied:.3e} FLOP/s implied "
+                f"(dt={dt:.6f}s for {inner} steps of {flops:.3e} FLOPs "
+                f"on {n_chips} chips, bound {bound:.3e})")
     return {
         "dtype": dtype,
         "batch": batch,
@@ -218,6 +259,31 @@ def _is_oom(exc) -> bool:
     text = f"{type(exc).__name__}: {exc}".lower()
     return ("resource_exhausted" in text or "out of memory" in text
             or "oom" in text or "exceeds the memory" in text)
+
+
+def _make_record(best, frames, size, on_tpu, kind):
+    value = best["clips_per_sec_per_chip"]
+    out = {
+        "metric": f"train_step clips/sec/chip ({frames}f@{size}, "
+                  f"{best['dtype']}, batch {best['batch']}"
+                  + (", s2d stem" if best.get("s2d") else "") + ")",
+        "value": value,
+        "unit": "clips/sec/chip",
+        # ratio vs the recorded TPU anchor — only meaningful on TPU (a
+        # CPU-fallback number against a TPU anchor would be noise).
+        "vs_baseline": (round(value / BASELINE_THROUGHPUT, 3)
+                        if BASELINE_THROUGHPUT and on_tpu else 1.0),
+        "timing": "differenced+host-materialized",
+        # The 95.35 anchor predates host-materialized differenced timing;
+        # part of any ratio != 1 is that method change.  Dropped when the
+        # anchor is re-measured under the current method.
+        "anchor_timing": "latency-inclusive (pre-differencing)",
+        "on_tpu": on_tpu,
+        "device_kind": str(kind),
+    }
+    if "mfu" in best:
+        out["mfu"] = best["mfu"]
+    return out
 
 
 def run_bench(on_tpu: bool):
@@ -246,13 +312,20 @@ def run_bench(on_tpu: bool):
         plans = [("float32", [2], False)]
 
     results = []
+    flops_seen = {}     # (dtype, remat, s2d) -> (batch, flops): linear scale
+
+    def hint(dtype, remat, s2d_, batch):
+        seen = flops_seen.get((dtype, remat, s2d_))
+        return seen[1] * batch / seen[0] if seen else None
+
     for dtype, batches, plan_remat in plans:
         prev = 0.0
         remat = plan_remat
         for batch in batches:
             try:
                 r = _bench_config(dtype, batch, frames, size, words, k,
-                                  remat, inner, s2d)
+                                  remat, inner, s2d, peak=peak,
+                                  flops_hint=hint(dtype, remat, s2d, batch))
             except Exception as exc:
                 if _is_oom(exc) and not remat:
                     _note(f"bench: {dtype} batch={batch} OOM — retrying with "
@@ -261,7 +334,9 @@ def run_bench(on_tpu: bool):
                     try:
                         r = _bench_config(dtype, batch, frames, size, words,
                                           k, remat=True, inner=inner,
-                                          s2d=s2d)
+                                          s2d=s2d, peak=peak,
+                                          flops_hint=hint(dtype, True, s2d,
+                                                          batch))
                     except Exception as exc2:
                         _note(f"bench: {dtype} batch={batch} remat also failed: "
                               f"{type(exc2).__name__} — stopping sweep")
@@ -272,15 +347,28 @@ def run_bench(on_tpu: bool):
                     _note(f"bench: {dtype} batch={batch} failed "
                           f"({type(exc).__name__}: {exc}) — stopping sweep")
                     break
+            if r["flops_per_step"]:
+                flops_seen.setdefault((dtype, remat, s2d),
+                                      (batch, r["flops_per_step"]))
             if peak and r["flops_per_sec"]:
                 r["mfu"] = round(r["flops_per_sec"] / (peak * len(devices)), 4)
             _note(f"bench: {r}")
             results.append(r)
+            # Interim record after every config: a later config hanging
+            # the tunnel must not cost the rows already measured — the
+            # parent forwards the LAST parsable stdout line it saw.
+            _emit(_make_record(
+                max(results, key=lambda x: x["clips_per_sec_per_chip"]),
+                frames, size, on_tpu, kind))
             # stop climbing once throughput flattens (<3% gain): HBM knee
             if r["clips_per_sec_per_chip"] < prev * 1.03:
                 break
             prev = r["clips_per_sec_per_chip"]
 
+    if not results:
+        raise RuntimeError(
+            "no config produced a measurement — every sweep arm failed "
+            "(see stderr for per-config errors)")
     best = max(results, key=lambda r: r["clips_per_sec_per_chip"])
 
     # One space_to_depth row at the winning operating point: the original
@@ -290,7 +378,8 @@ def run_bench(on_tpu: bool):
     if on_tpu and not s2d and os.environ.get("MILNCE_BENCH_S2D") != "0":
         try:
             r = _bench_config(best["dtype"], best["batch"], frames, size,
-                              words, k, best["remat"], inner, s2d=True)
+                              words, k, best["remat"], inner, s2d=True,
+                              peak=peak)
             if peak and r["flops_per_sec"]:
                 r["mfu"] = round(r["flops_per_sec"] / (peak * len(devices)), 4)
             _note(f"bench: {r}")
@@ -301,28 +390,7 @@ def run_bench(on_tpu: bool):
                   "keeping plain-stem results")
 
     _write_notes(results, best, kind, on_tpu, len(devices))
-    value = best["clips_per_sec_per_chip"]
-    out = {
-        "metric": f"train_step clips/sec/chip ({frames}f@{size}, "
-                  f"{best['dtype']}, batch {best['batch']}"
-                  + (", s2d stem" if best.get("s2d") else "") + ")",
-        "value": value,
-        "unit": "clips/sec/chip",
-        # ratio vs the recorded TPU anchor — only meaningful on TPU (a
-        # CPU-fallback number against a TPU anchor would be noise).  The
-        # 95.35 anchor predates differenced timing, which removed ~20%
-        # of tunnel latency from the reading — part of any ratio > 1 is
-        # that method change, flagged until the anchor is re-measured.
-        "vs_baseline": (round(value / BASELINE_THROUGHPUT, 3)
-                        if BASELINE_THROUGHPUT and on_tpu else 1.0),
-        "timing": "differenced",
-        "anchor_timing": "latency-inclusive (pre-differencing)",
-        "on_tpu": on_tpu,
-        "device_kind": str(kind),
-    }
-    if "mfu" in best:
-        out["mfu"] = best["mfu"]
-    return out
+    return _make_record(best, frames, size, on_tpu, kind)
 
 
 def _write_notes(results, best, kind, on_tpu, n_chips):
@@ -365,10 +433,13 @@ def main():
 
         mode = os.environ.get(_CHILD_MODE_ENV)
         if mode in ("cpu", "tpu"):
-            # Child: measure and print the record to stdout (captured by
-            # the parent, which is the single emitter).  On ANY failure
-            # exit nonzero with no record — the parent falls back; a
-            # swallowed 0.0 record here would mask a working CPU path.
+            # Child: measure and print records to stdout (captured by the
+            # parent, which is the single emitter).  run_bench streams an
+            # interim best-so-far record after each config, so a child
+            # that dies mid-sweep leaves its completed rows behind; a
+            # child that fails before ANY config exits nonzero with no
+            # record and the parent falls back — a swallowed 0.0 record
+            # here would mask a working CPU path.
             try:
                 if mode == "cpu":
                     jax.config.update("jax_platforms", "cpu")
@@ -445,6 +516,9 @@ def main():
         rec, status = run_child("cpu", timeout=cpu_budget)
         if rec is None:
             raise RuntimeError(f"CPU fallback child {status} with no record")
+        if status != "ok":
+            _note(f"bench: CPU child {status}; forwarding the record it "
+                  "emitted before dying")
         _emit(rec)
     except Exception as exc:  # LAST RESORT: the line must always be parsable
         _emit({"metric": "train_step clips/sec/chip", "value": 0.0,

@@ -142,10 +142,11 @@ def test_no_length_cap():
     assert np.isfinite(float(out[0]))
 
 
-def test_auto_backend_dispatch():
+def test_auto_backend_dispatch(monkeypatch):
     """backend='auto' picks the kernel wherever a measured-winning layout
     applies (one-block sublane-batch, or batch-on-lanes at any batch) and
     the scan elsewhere; both arms must agree with the scan."""
+    monkeypatch.delenv("MILNCE_SDTW_LANES", raising=False)
     from milnce_tpu.ops.softdtw import SoftDTW
 
     from milnce_tpu.ops.softdtw_pallas import (_batch_tile, fits_one_block,

@@ -110,6 +110,7 @@ def test_lanes_layout_matches_scan(monkeypatch):
     kernels by default (measured 3.5-26x on v5e, BENCH_SOFTDTW.md);
     values and grads must match the scan (multi-block at B=300,
     rectangular, and the 32x32 MIL shape)."""
+    monkeypatch.delenv("MILNCE_SDTW_LANES", raising=False)
     from milnce_tpu.ops import softdtw_pallas as sp
 
     rng = np.random.RandomState(13)
