@@ -56,6 +56,22 @@ def _emit(result):
     sys.stdout.flush()
 
 
+def _last_json(raw: bytes):
+    """The last parsable bench record in a child's captured stdout (the
+    interim-streaming protocol: later records supersede earlier ones)."""
+    for line in reversed(raw.decode(errors="replace").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except Exception:
+                continue
+            # only the bench record, not stray JSON-shaped log lines
+            if isinstance(rec, dict) and "metric" in rec and "value" in rec:
+                return rec
+    return None
+
+
 def _note(msg):
     sys.stderr.write(msg + "\n")
     sys.stderr.flush()
@@ -309,7 +325,9 @@ def run_bench(on_tpu: bool):
     else:
         frames, size, words, k = 4, 64, 6, 3
         inner = 1
-        plans = [("float32", [2], False)]
+        # batch must divide over the data mesh (a host forced to N virtual
+        # CPU devices — the test rig — still has to measure something)
+        plans = [("float32", [2 * len(devices)], False)]
 
     results = []
     flops_seen = {}     # (dtype, remat, s2d) -> (batch, flops): linear scale
@@ -331,6 +349,11 @@ def run_bench(on_tpu: bool):
                     _note(f"bench: {dtype} batch={batch} OOM — retrying with "
                           "remat (kept on for larger batches)")
                     remat = True   # larger batches can only need MORE memory
+                    # remat recomputes activations, so this row dropping
+                    # below the last non-remat row is expected — reset the
+                    # knee reference so the drop doesn't end the plan
+                    # before larger remat batches get their shot.
+                    prev = 0.0
                     try:
                         r = _bench_config(dtype, batch, frames, size, words,
                                           k, remat=True, inner=inner,
@@ -456,21 +479,8 @@ def main():
         # failure mode — crash, hang at init, or hang at first execute
         # (all three observed) — can eat the driver's gate timeout
         # without a JSON line being printed.  Child stdout is captured
-        # and the LAST parsable JSON line forwarded, so exactly one
-        # record ever reaches the driver.
-        def last_json(raw: bytes):
-            for line in reversed(raw.decode(errors="replace").splitlines()):
-                line = line.strip()
-                if line.startswith("{"):
-                    try:
-                        rec = json.loads(line)
-                    except Exception:
-                        continue
-                    # only the bench record, not stray JSON-shaped log lines
-                    if isinstance(rec, dict) and "metric" in rec and "value" in rec:
-                        return rec
-            return None
-
+        # and the LAST parsable JSON line forwarded (_last_json), so
+        # exactly one record ever reaches the driver.
         def run_child(child_mode: str, timeout=None):
             env = dict(os.environ)
             env[_CHILD_MODE_ENV] = child_mode
@@ -493,7 +503,7 @@ def main():
                     proc.kill()
                     out, _ = proc.communicate()
                 status = f"timeout>{timeout}s"
-            return last_json(out or b""), status
+            return _last_json(out or b""), status
 
         if _probe_backend():
             # Even a healthy-probing tunnel can wedge mid-sweep; bound the
@@ -504,6 +514,9 @@ def main():
                 if status != "ok":
                     _note(f"bench: TPU child {status}; forwarding the record "
                           "it emitted before dying")
+                    # machine-visible truncation: a best-so-far from a dead
+                    # child must not read as a complete sweep
+                    rec["partial"] = status
                 _emit(rec)
                 return
             _note(f"bench: TPU child {status} with no record — CPU fallback")
@@ -519,6 +532,7 @@ def main():
         if status != "ok":
             _note(f"bench: CPU child {status}; forwarding the record it "
                   "emitted before dying")
+            rec["partial"] = status
         _emit(rec)
     except Exception as exc:  # LAST RESORT: the line must always be parsable
         _emit({"metric": "train_step clips/sec/chip", "value": 0.0,

@@ -1,0 +1,92 @@
+"""bench.py gate machinery: record forwarding, schema, and the
+end-to-end CPU measurement child.
+
+The bench is a driver gate — its one-JSON-line contract failing is
+round-1's top verdict item — so its pure logic is unit-tested here and
+the CPU child is exercised as a real subprocess.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import bench  # noqa: E402
+
+
+class TestLastJson:
+    def test_picks_last_record(self):
+        raw = (b'{"metric": "m", "value": 1.0, "unit": "u"}\n'
+               b'{"metric": "m", "value": 2.0, "unit": "u"}\n')
+        assert bench._last_json(raw)["value"] == 2.0
+
+    def test_skips_non_record_json(self):
+        # stray JSON-shaped log lines after the record must not win
+        raw = (b'{"metric": "m", "value": 3.0}\n'
+               b'{"event": "shutdown"}\n'
+               b'not json at all\n')
+        assert bench._last_json(raw)["value"] == 3.0
+
+    def test_unparsable_tail_then_record(self):
+        raw = b'garbage\n{"metric": "m", "value": 4.0}\n{"broken\n'
+        assert bench._last_json(raw)["value"] == 4.0
+
+    def test_no_record(self):
+        assert bench._last_json(b"") is None
+        assert bench._last_json(b"warning: something\n") is None
+
+
+class TestMakeRecord:
+    BEST = {"dtype": "bfloat16", "batch": 256, "remat": False, "s2d": False,
+            "clips_per_sec_per_chip": 100.0, "mfu": 0.05}
+
+    def test_schema_and_anchor(self):
+        rec = bench._make_record(self.BEST, 16, 224, True, "TPU v5 lite")
+        assert rec["unit"] == "clips/sec/chip"
+        assert rec["value"] == 100.0
+        assert rec["on_tpu"] is True
+        assert rec["mfu"] == 0.05
+        assert rec["vs_baseline"] == round(100.0 / bench.BASELINE_THROUGHPUT, 3)
+        assert "16f@224" in rec["metric"] and "bfloat16" in rec["metric"]
+
+    def test_cpu_fallback_vs_baseline_is_neutral(self):
+        # a CPU number against a TPU anchor would be noise; pinned to 1.0
+        rec = bench._make_record(self.BEST, 4, 64, False, "cpu")
+        assert rec["vs_baseline"] == 1.0 and rec["on_tpu"] is False
+
+    def test_s2d_flagged_in_metric(self):
+        best = dict(self.BEST, s2d=True)
+        rec = bench._make_record(best, 16, 224, True, "TPU v5 lite")
+        assert "s2d stem" in rec["metric"]
+
+
+def test_peak_flops_lookup():
+    assert bench._peak_flops("TPU v5 lite") == 197e12
+    assert bench._peak_flops("TPU v4") == 275e12
+    assert bench._peak_flops("cpu") is None
+
+
+@pytest.mark.slow
+def test_cpu_child_end_to_end():
+    """The CPU measurement child — the gate's last line of defense before
+    the error record — must emit at least one parsable record with a
+    positive value (interim + final; the parent forwards the last)."""
+    env = dict(os.environ)
+    env["MILNCE_BENCH_CHILD_MODE"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, os.path.join(_REPO, "bench.py")],
+                          env=env, cwd=_REPO, capture_output=True,
+                          timeout=900)
+    assert proc.returncode == 0, proc.stderr.decode()[-500:]
+    rec = bench._last_json(proc.stdout)
+    assert rec is not None, proc.stdout
+    assert rec["value"] > 0 and rec["on_tpu"] is False
+    assert rec["unit"] == "clips/sec/chip"
+    # schema fields the driver relies on
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec
