@@ -74,10 +74,12 @@ class LossConfig:
 
     name: str = "milnce"                # milnce | cdtw | sdtw_cidm | sdtw_negative | sdtw_3
     sdtw_backend: str = "auto"          # auto | scan | pallas; auto picks the
-                                        # TPU wavefront kernel when the batch
-                                        # fits one VMEM block, scan otherwise
-                                        # (BENCH_SOFTDTW.md; reference always
-                                        # ran CUDA, loss.py:26-97)
+                                        # TPU wavefront kernel wherever a
+                                        # measured-winning layout applies
+                                        # (batch-on-lanes or one-block), scan
+                                        # otherwise (BENCH_SOFTDTW.md;
+                                        # reference always ran CUDA,
+                                        # loss.py:26-97)
     sdtw_gamma: float = 0.1             # loss.py:38,74,97 (cdtw uses 1e-5, loss.py:26)
     sdtw_dist: str = "cosine"           # cosine | negative_dot | negative_cosine | euclidean
     sdtw_bandwidth: int = 0             # Sakoe-Chiba band; 0 = off
@@ -108,6 +110,13 @@ class ParallelConfig:
     coordinator_address: Optional[str] = None   # multi-host bootstrap (None = single host)
     num_processes: Optional[int] = None
     process_id: Optional[int] = None
+    platform: str = ""                  # force a jax backend ('cpu' for
+                                        # hermetic runs on accelerator
+                                        # hosts; '' = jax default).  Env
+                                        # vars alone don't suffice —
+                                        # accelerator plugins override
+                                        # JAX_PLATFORMS, so this applies
+                                        # jax.config before backend init.
 
 
 @dataclass

@@ -84,7 +84,12 @@ def main(argv=None):
     p.add_argument("--fake_decoder", action="store_true",
                    help="deterministic in-memory decoder (no ffmpeg/videos); "
                         "hermetic CLI smoke only")
+    p.add_argument("--platform", default="",
+                   help="force a jax backend (e.g. 'cpu' for hermetic runs "
+                        "on accelerator hosts)")
     args = p.parse_args(argv)
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
 
     data_cfg = DataConfig(fps=args.fps, num_frames=args.num_frames,
                           video_size=args.video_size, max_words=args.max_words)

@@ -54,6 +54,9 @@ def _multihost_tpu_env() -> bool:
 def initialize_distributed(cfg: ParallelConfig) -> None:
     """Multi-host process bootstrap.
 
+    - ``platform`` set: pin the jax backend first (``jax.config`` wins
+      where a bare env var loses to accelerator plugins) — hermetic CPU
+      runs on accelerator hosts;
     - explicit ``coordinator_address``: classic bring-up (any platform);
     - no address but a multi-host TPU slice detected: bare
       ``jax.distributed.initialize()`` — coordinator, process count and
@@ -61,12 +64,20 @@ def initialize_distributed(cfg: ParallelConfig) -> None:
       reference's hand-maintained 10-IP list, train.py:48);
     - single host: no-op, ``jax.devices()`` already sees every chip.
     """
+    if cfg.platform:
+        jax.config.update("jax_platforms", cfg.platform)
     if cfg.coordinator_address:
         jax.distributed.initialize(
             coordinator_address=cfg.coordinator_address,
             num_processes=cfg.num_processes,
             process_id=cfg.process_id,
         )
+    elif cfg.platform and cfg.platform != "tpu":
+        # pinned off the TPU: a hermetic single-process run on an
+        # accelerator host must NOT auto-join the pod's jax.distributed
+        # cluster (it would block at the coordinator barrier waiting for
+        # workers that were never launched)
+        pass
     elif _multihost_tpu_env():
         jax.distributed.initialize()
 

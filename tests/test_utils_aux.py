@@ -81,6 +81,44 @@ class TestMultihostDetect:
         assert called[0]["coordinator_address"] == "10.0.0.1:8476"
         assert called[0]["num_processes"] == 2
 
+    def test_platform_pin_applies_jax_config(self, monkeypatch):
+        """--parallel.platform pins the backend via jax.config (env vars
+        alone lose to accelerator plugins); '' leaves it untouched."""
+        import milnce_tpu.parallel.mesh as mesh_mod
+        from milnce_tpu.config import ParallelConfig, parse_cli
+
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+        updates = []
+        monkeypatch.setattr(mesh_mod.jax.config, "update",
+                            lambda k, v: updates.append((k, v)))
+        mesh_mod.initialize_distributed(ParallelConfig())
+        assert updates == []                        # default: no pin
+        mesh_mod.initialize_distributed(ParallelConfig(platform="cpu"))
+        assert updates == [("jax_platforms", "cpu")]
+        # threaded through the CLI front-end
+        cfg = parse_cli(["--parallel.platform", "cpu"])
+        assert cfg.parallel.platform == "cpu"
+
+    def test_platform_pin_skips_multihost_autojoin(self, monkeypatch):
+        """A CPU-pinned hermetic run on a multi-host TPU slice must NOT
+        auto-join the pod's distributed cluster (it would block at the
+        coordinator barrier waiting for never-launched workers)."""
+        import milnce_tpu.parallel.mesh as mesh_mod
+        from milnce_tpu.config import ParallelConfig
+
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "t1w-0,t1w-1,t1w-2")
+        monkeypatch.setattr(mesh_mod.jax.config, "update", lambda k, v: None)
+        called = []
+        monkeypatch.setattr(mesh_mod.jax.distributed, "initialize",
+                            lambda *a, **k: called.append((a, k)))
+        mesh_mod.initialize_distributed(ParallelConfig(platform="cpu"))
+        assert called == []
+        # explicit coordinator still wins even with a pin
+        mesh_mod.initialize_distributed(ParallelConfig(
+            platform="cpu", coordinator_address="10.0.0.1:8476",
+            num_processes=3, process_id=0))
+        assert len(called) == 1
+
 
 class TestNaNGuard:
     def test_halts_and_checkpoints_on_nan(self, tmp_path):
