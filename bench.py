@@ -127,29 +127,22 @@ def _devices():
 
 
 def _step_flops(step_fn, args):
-    """Per-step FLOPs from XLA's cost analysis of the single-step program
-    (unlike analyzing the inner_steps>1 scan program, this counts the
-    whole step exactly once).  The lowered-but-uncompiled analysis is
-    tried first (cheap); some backends (axon tunnel, 2026-07-30) return
-    None from it, so fall back to compiling — the compile is cached and
-    single-step, so the cost is bounded."""
+    """Per-step FLOPs from XLA's cost analysis of the lowered single-step
+    program (unlike analyzing the inner_steps>1 scan program, this counts
+    the whole step exactly once; lowering is compile-free).  Some
+    backends (axon tunnel, 2026-07-30) return None here — the caller
+    then falls back to the analytic roofline model rather than paying a
+    full-model compile just for the MFU diagnostic."""
     try:
-        lowered = step_fn.lower(*args)
+        cost = step_fn.lower(*args).cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
+        if cost:
+            flops = float(cost.get("flops", 0.0))
+            if flops > 0:
+                return flops
     except Exception as exc:
-        _note(f"bench: lowering for cost analysis failed: {exc}")
-        return None
-    for stage in ("lowered", "compiled"):
-        try:
-            obj = lowered if stage == "lowered" else lowered.compile()
-            cost = obj.cost_analysis()
-            if isinstance(cost, (list, tuple)):
-                cost = cost[0] if cost else None
-            if cost:
-                flops = float(cost.get("flops", 0.0))
-                if flops > 0:
-                    return flops
-        except Exception as exc:
-            _note(f"bench: {stage} cost_analysis unavailable: {exc}")
+        _note(f"bench: cost_analysis unavailable: {exc}")
     return None
 
 
@@ -197,14 +190,32 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
     start_d = jax.device_put(np.zeros((batch,), np.float32))
 
     if flops_hint is not None:
-        # Model FLOPs are linear in batch at fixed (frames, size, arch):
-        # reuse the plan's first measured config instead of paying another
-        # full-model compile over the tunnel just for the MFU diagnostic.
-        flops = flops_hint
+        # Seeded from an earlier XLA-counted config of the same plan (see
+        # run_bench's hint(), which rescales model and logits terms
+        # separately) — avoids another full-model compile over the tunnel
+        # just for the MFU diagnostic.
+        flops, flops_source = flops_hint, "hint"
     else:
         single = (step_fn if inner == 1 else
                   make_train_step(model, optimizer, mesh, donate=False))
         flops = _step_flops(single, (state, video_d, text_d, start_d))
+        if flops is not None:
+            flops_source = "xla"
+        else:
+            # analytic model (valid-tap conv counting, pinned against
+            # XLA's own analysis in tests/test_roofline.py) — no extra
+            # compile over the tunnel, exact at every batch.  Arch fields
+            # come from the SAME cfg.model the timed step was built from.
+            from milnce_tpu.utils.roofline import train_step_flops
+
+            flops = train_step_flops(
+                batch, frames, size, k, words, space_to_depth=s2d,
+                inception_blocks=cfg.model.inception_blocks,
+                embedding_dim=cfg.model.embedding_dim,
+                word_dim=cfg.model.word_embedding_dim,
+                hidden=cfg.model.text_hidden_dim)
+            flops_source = "analytic"
+            _note(f"bench: using analytic FLOPs model ({flops:.3e}/step)")
 
     # warmup / compile
     state, loss = step_fn(state, video_d, text_d, start_d)
@@ -267,6 +278,7 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
         "step_ms": round(dt / inner * 1e3, 2),
         "clips_per_sec_per_chip": round(batch * inner / dt / n_chips, 3),
         "flops_per_step": flops,
+        "flops_source": flops_source if flops else None,
         "flops_per_sec": (flops * inner / dt) if flops else None,
     }
 
@@ -336,11 +348,21 @@ def run_bench(on_tpu: bool):
         plans = [("float32", [2 * len(devices)], False)]
 
     results = []
-    flops_seen = {}     # (dtype, remat, s2d) -> (batch, flops): linear scale
+    # (dtype, remat, s2d) -> (batch, flops) seeds, XLA-sourced only (the
+    # analytic model is free to recompute exactly at every batch)
+    flops_seen = {}
 
     def hint(dtype, remat, s2d_, batch):
         seen = flops_seen.get((dtype, remat, s2d_))
-        return seen[1] * batch / seen[0] if seen else None
+        if not seen:
+            return None
+        # model FLOPs scale linearly in batch; the MIL-NCE logits matmul
+        # is quadratic — rescale the two terms separately
+        from milnce_tpu.utils.roofline import milnce_logits_flops
+
+        b0, f0 = seen
+        linear = f0 - milnce_logits_flops(b0, k)
+        return linear * batch / b0 + milnce_logits_flops(batch, k)
 
     for dtype, batches, plan_remat in plans:
         prev = 0.0
@@ -376,7 +398,7 @@ def run_bench(on_tpu: bool):
                     _note(f"bench: {dtype} batch={batch} failed "
                           f"({type(exc).__name__}: {exc}) — stopping sweep")
                     break
-            if r["flops_per_step"]:
+            if r["flops_per_step"] and r.get("flops_source") == "xla":
                 flops_seen.setdefault((dtype, remat, s2d),
                                       (batch, r["flops_per_step"]))
             if peak and r["flops_per_sec"]:
@@ -443,6 +465,8 @@ def _write_notes(results, best, kind, on_tpu, n_chips):
                          f"{r.get('s2d', False)} | "
                          f"{r['step_ms']} | {r['clips_per_sec_per_chip']} | "
                          f"{r.get('mfu', '-')} |")
+        lines += ["", "Roofline context for these numbers: PERF.md "
+                  "(analytic per-stage FLOPs/bytes/intensity model)."]
         with open(os.path.join(_REPO, "BENCH_NOTES.md"), "w") as fh:
             fh.write("\n".join(lines) + "\n")
     except Exception as exc:
