@@ -4,16 +4,39 @@ from milnce_tpu.config import parse_cli, small_preset, tiny_preset
 
 
 def test_full_defaults_match_reference_args():
+    """Every behavioral default of /root/reference/args.py:3-52, pinned
+    (path-like defaults excluded — environment leaks, SURVEY §2.4)."""
     cfg = parse_cli([])
-    # args.py defaults
-    assert cfg.train.batch_size == 128
-    assert cfg.optim.lr == 1e-3
-    assert cfg.optim.warmup_steps == 50_000
-    assert cfg.data.fps == 10
-    assert cfg.data.num_frames == 32
-    assert cfg.data.video_size == 224
-    assert cfg.data.num_candidates == 5
-    assert cfg.model.embedding_dim == 512
+    expected = {
+        "optim.name": "adam",               # args.py:12
+        "model.weight_init": "uniform",     # args.py:13
+        "data.num_reader_threads": 20,      # args.py:14
+        "model.embedding_dim": 512,         # args.py:15 --num_class
+        "data.num_candidates": 5,           # args.py:16
+        "train.batch_size": 128,            # args.py:17
+        "train.num_windows_test": 4,        # args.py:18
+        "train.batch_size_val": 32,         # args.py:19
+        "optim.momentum": 0.9,              # args.py:20 (the typo'd --momemtum)
+        "train.n_display": 400,             # args.py:21
+        "data.num_frames": 32,              # args.py:22
+        "data.video_size": 224,             # args.py:23
+        "data.crop_only": True,             # args.py:24
+        "data.center_crop": False,          # args.py:25
+        "data.random_flip": True,           # args.py:26
+        "train.verbose": True,              # args.py:27
+        "optim.warmup_steps": 50_000,       # args.py:28
+        "data.min_time": 5.0,               # args.py:29
+        "data.fps": 10,                     # args.py:32
+        "optim.epochs": 300,                # args.py:34
+        "optim.lr": 1e-3,                   # args.py:36
+        "train.resume": False,              # args.py:38
+        "train.evaluate": False,            # args.py:39
+        "train.seed": 1,                    # args.py:47
+    }
+    for key, want in expected.items():
+        section, field = key.split(".")
+        got = getattr(getattr(cfg, section), field)
+        assert got == want, f"{key}: {got!r} != reference default {want!r}"
 
 
 def test_small_preset_deltas():
