@@ -157,16 +157,16 @@ def full_preset() -> Config:
 
 
 def small_preset() -> Config:
-    """Scaled-down run: the args_small.py deltas (batch 12, warmup 1000,
-    100 epochs, 16 frames) made actually runnable — the reference's
-    train_small.py is import-broken (SURVEY.md §2.4)."""
+    """Scaled-down run: EXACTLY the args_small.py deltas over args.py
+    (batch 12 :17, n_display 100 :21, warmup 1000 :28, 100 epochs :34)
+    made actually runnable — the reference's train_small.py is
+    import-broken (SURVEY.md §2.4).  Input shapes stay the full run's
+    (32f@224, K=5), as args_small keeps them."""
     cfg = Config()
     cfg.train.batch_size = 12
+    cfg.train.n_display = 100
     cfg.optim.warmup_steps = 1000
     cfg.optim.epochs = 100
-    cfg.data.num_frames = 16
-    cfg.data.video_size = 128
-    cfg.data.num_candidates = 1
     return cfg
 
 
@@ -177,6 +177,7 @@ def tiny_preset() -> Config:
     cfg.data.num_frames = 4
     cfg.data.video_size = 32
     cfg.data.max_words = 6
+    cfg.data.num_candidates = 1
     cfg.train.batch_size = 4
     cfg.model.vocab_size = 128
     cfg.optim.warmup_steps = 2

@@ -40,11 +40,15 @@ def test_full_defaults_match_reference_args():
 
 
 def test_small_preset_deltas():
+    """Exactly the args_small.py deltas (diff vs args.py); everything
+    else — input shapes included — stays at the full-run defaults."""
     cfg = small_preset()
-    assert cfg.train.batch_size == 12
-    assert cfg.optim.warmup_steps == 1000
-    assert cfg.optim.epochs == 100
-    assert cfg.data.num_frames == 16
+    assert cfg.train.batch_size == 12          # args_small.py:17
+    assert cfg.train.n_display == 100          # args_small.py:21
+    assert cfg.optim.warmup_steps == 1000      # args_small.py:28
+    assert cfg.optim.epochs == 100             # args_small.py:34
+    assert cfg.data.num_frames == 32           # unchanged by args_small
+    assert cfg.data.num_candidates == 5        # unchanged by args_small
 
 
 def test_cli_overrides():
