@@ -139,6 +139,9 @@ class TrainConfig:
     trace_dir: str = ""                 # jax.profiler trace output ('' = off)
     halt_on_nan: bool = True            # checkpoint + halt when the windowed
                                         # loss goes non-finite (divergence guard)
+    max_steps: Optional[int] = None     # stop (with a checkpoint) after N
+                                        # optimizer steps — bounded smoke /
+                                        # bench runs; None = run all epochs
 
 
 @dataclass

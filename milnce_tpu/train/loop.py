@@ -84,6 +84,8 @@ def _in_training_eval(cfg: Config, model, state: TrainState, mesh,
 
 
 def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
+    if max_steps is None:
+        max_steps = cfg.train.max_steps
     if cfg.train.evaluate:
         from milnce_tpu.eval.runner import EVAL_TASKS
 
