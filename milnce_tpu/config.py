@@ -81,7 +81,12 @@ class LossConfig:
                                         # reference always ran CUDA,
                                         # loss.py:26-97)
     sdtw_gamma: float = 0.1             # loss.py:38,74,97 (cdtw uses 1e-5, loss.py:26)
-    sdtw_dist: str = "cosine"           # cosine | negative_dot | negative_cosine | euclidean
+    sdtw_dist: str = ""                 # '' = each loss's reference default
+                                        # (cosine for cdtw/cidm/negative,
+                                        # negative_dot for sdtw_3 — loss.py:
+                                        # 26,38,74,97); override with any of
+                                        # cosine | negative_dot |
+                                        # negative_cosine | euclidean
     sdtw_bandwidth: int = 0             # Sakoe-Chiba band; 0 = off
     cidm_sigma: float = 10.0            # loss.py:58
     cidm_lambda: float = 1.0            # loss.py:57

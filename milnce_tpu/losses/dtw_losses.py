@@ -18,11 +18,13 @@ from milnce_tpu.ops.softdtw import SoftDTW, _cosine_sim
 
 
 def cdtw_batch_loss(video_seq: jax.Array, text_seq: jax.Array,
-                    gamma: float = 1e-5, backend: str = "scan") -> jax.Array:
+                    gamma: float = 1e-5, backend: str = "scan",
+                    dist: str = "", bandwidth: int = 0) -> jax.Array:
     """Batch-mean contrastive DTW: the reference's CDTW (loss.py:20-32)
     scores only the ``args.rank``-th anchor per step; averaging over every
     anchor is the batch-generic equivalent (identical in expectation)."""
-    sdtw = SoftDTW(gamma=gamma, dist_func="cosine", backend=backend)
+    sdtw = SoftDTW(gamma=gamma, dist_func=dist or "cosine",
+                   bandwidth=bandwidth, backend=backend)
     pairs = _all_pairs_sdtw(video_seq, text_seq, sdtw)     # pairs[i,j] =
     pos = jnp.diagonal(pairs)                              #   sdtw(v_j, t_i)
     # reference anchor r scores its VIDEO against every text
@@ -32,13 +34,15 @@ def cdtw_batch_loss(video_seq: jax.Array, text_seq: jax.Array,
 
 
 def cdtw_loss(video_seq: jax.Array, text_seq: jax.Array, index: jax.Array | int,
-              gamma: float = 1e-5, backend: str = "scan") -> jax.Array:
+              gamma: float = 1e-5, backend: str = "scan",
+              dist: str = "", bandwidth: int = 0) -> jax.Array:
     """Contrastive DTW for one anchor row (reference CDTW, loss.py:20-32):
     soft-DTW(v_i, t_i) vs logsumexp over soft-DTW(v_i, t_j) for all j.
 
     ``index`` generalizes the reference's ``args.rank`` anchor choice.
     """
-    sdtw = SoftDTW(gamma=gamma, dist_func="cosine", backend=backend)
+    sdtw = SoftDTW(gamma=gamma, dist_func=dist or "cosine",
+                   bandwidth=bandwidth, backend=backend)
     b = video_seq.shape[0]
     v_i = jax.lax.dynamic_index_in_dim(video_seq, index, 0, keepdims=True)
     t_i = jax.lax.dynamic_index_in_dim(text_seq, index, 0, keepdims=True)
@@ -49,7 +53,8 @@ def cdtw_loss(video_seq: jax.Array, text_seq: jax.Array, index: jax.Array | int,
 
 def sdtw_cidm_loss(video_seq: jax.Array, text_seq: jax.Array,
                    start: jax.Array, gamma: float = 0.1, sigma: float = 10.0,
-                   lam: float = 1.0, backend: str = "scan") -> jax.Array:
+                   lam: float = 1.0, backend: str = "scan",
+                   dist: str = "", bandwidth: int = 0) -> jax.Array:
     """Soft-DTW + Clip-Interval-Distance-Metric regularizers (reference
     SDTW_CIDM, loss.py:34-68).
 
@@ -64,10 +69,11 @@ def sdtw_cidm_loss(video_seq: jax.Array, text_seq: jax.Array,
     indices; we define the clip-pair distance cleanly as the cosine
     distance between frame-mean embeddings.
     """
-    sdtw = SoftDTW(gamma=gamma, dist_func="cosine", backend=backend)
-    dist = jnp.abs(start[:, None] - start[None, :])          # (B, B)
-    far = jnp.where(dist > sigma, 1.0, 0.0)
-    w_ = dist + 1.0
+    sdtw = SoftDTW(gamma=gamma, dist_func=dist or "cosine",
+                   bandwidth=bandwidth, backend=backend)
+    interval = jnp.abs(start[:, None] - start[None, :])      # (B, B)
+    far = jnp.where(interval > sigma, 1.0, 0.0)
+    w_ = interval + 1.0
     w = 1.0 / w_
     v_mean = jnp.mean(video_seq, axis=1)
     t_mean = jnp.mean(text_seq, axis=1)
@@ -80,7 +86,8 @@ def sdtw_cidm_loss(video_seq: jax.Array, text_seq: jax.Array,
 
 
 def sdtw_negative_loss(video_seq: jax.Array, text_seq: jax.Array,
-                       gamma: float = 0.1, backend: str = "scan") -> jax.Array:
+                       gamma: float = 0.1, backend: str = "scan",
+                       dist: str = "", bandwidth: int = 0) -> jax.Array:
     """Soft-DTW positives + frame-level InfoNCE-style negatives (reference
     SDTW_negative, loss.py:70-91), batch-generic.
 
@@ -88,7 +95,8 @@ def sdtw_negative_loss(video_seq: jax.Array, text_seq: jax.Array,
     the within-clip n x n blocks of the (B*n, B*n) video-frame/text-frame
     dot matrix; we mask the block diagonal directly.
     """
-    sdtw = SoftDTW(gamma=gamma, dist_func="cosine", backend=backend)
+    sdtw = SoftDTW(gamma=gamma, dist_func=dist or "cosine",
+                   bandwidth=bandwidth, backend=backend)
     b, n, d = video_seq.shape
     m = text_seq.shape[1]
     pos = sdtw(video_seq, text_seq)                          # (B,)
@@ -114,10 +122,12 @@ def _all_pairs_sdtw(a: jax.Array, b_seq: jax.Array, sdtw: SoftDTW) -> jax.Array:
 
 
 def sdtw_3_loss(video_seq: jax.Array, text_seq: jax.Array, gamma: float = 0.1,
-                backend: str = "scan") -> tuple[jax.Array, jax.Array, jax.Array]:
+                backend: str = "scan", dist: str = "",
+                bandwidth: int = 0) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Three NCE-over-soft-DTW terms — video<->video, video<->text,
     text<->text (reference SDTW_3, loss.py:93-134), negative-dot distance."""
-    sdtw = SoftDTW(gamma=gamma, dist_func="negative_dot", backend=backend)
+    sdtw = SoftDTW(gamma=gamma, dist_func=dist or "negative_dot",
+                   bandwidth=bandwidth, backend=backend)
 
     def nce(x, y):
         pos = -sdtw(x, y)

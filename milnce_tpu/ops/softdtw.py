@@ -62,6 +62,19 @@ def softmin3(a, b, c, gamma):
     return -gamma * jax.nn.logsumexp(stack, axis=0)
 
 
+def check_bandwidth(n: int, m: int, bandwidth: int) -> None:
+    """A Sakoe-Chiba band narrower than |N - M| prunes the terminal DP
+    cell: every value degenerates to the finite BIG sentinel and training
+    silently flatlines (no NaN for the divergence guard to catch).
+    Shapes are static under jit, so this check costs nothing."""
+    if 0 < bandwidth < abs(n - m):
+        raise ValueError(
+            f"sdtw bandwidth {bandwidth} cannot cover the |N-M| = "
+            f"{abs(n - m)} length difference of a {n}x{m} alignment — the "
+            "terminal cell is outside the band and every soft-DTW value "
+            "degenerates to the BIG sentinel")
+
+
 @partial(jax.jit, static_argnames=("bandwidth",))
 def softdtw_scan(D: jax.Array, gamma: float, bandwidth: int = 0) -> jax.Array:
     """Soft-DTW values for a batch of cost matrices.
@@ -74,6 +87,7 @@ def softdtw_scan(D: jax.Array, gamma: float, bandwidth: int = 0) -> jax.Array:
     Returns: (B,) soft-DTW alignment costs R[N, M].
     """
     bsz, n, m = D.shape
+    check_bandwidth(n, m, bandwidth)
     d_skew = skew_cost(D)                       # (B, N+M-1, N)
     gamma = jnp.asarray(gamma, D.dtype)
 
@@ -173,6 +187,11 @@ class SoftDTW:
         self.gamma = float(gamma)
         self.normalize = normalize
         self.bandwidth = 0 if bandwidth is None else int(bandwidth)
+        if dist_func not in DIST_FUNCS:
+            raise ValueError(
+                f"unknown soft-DTW dist_func {dist_func!r} (the "
+                f"--loss.sdtw_dist knob); expected one of "
+                f"{sorted(DIST_FUNCS)}")
         self.dist_func = DIST_FUNCS[dist_func]
         if backend not in ("scan", "pallas", "auto"):
             raise ValueError(f"unknown soft-DTW backend {backend!r}")

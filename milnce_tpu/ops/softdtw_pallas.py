@@ -52,7 +52,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from milnce_tpu.ops.softdtw import BIG, skew_cost
+from milnce_tpu.ops.softdtw import BIG, check_bandwidth, skew_cost
 
 
 def _interpret() -> bool:
@@ -576,6 +576,7 @@ def softdtw_pallas(D: jax.Array, gamma: float = 1.0,
 
 def _softdtw_pallas_fwd(D, gamma, bandwidth):
     bsz, n, m = D.shape
+    check_bandwidth(n, m, int(bandwidth))
     d_skew = skew_cost(D.astype(jnp.float32))
     if _use_lanes(bsz, n, m):
         value, r_skew = _run_forward_lanes(d_skew, n, m, float(gamma),

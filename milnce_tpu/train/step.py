@@ -46,22 +46,20 @@ def _sequence_loss(loss_cfg, v_seq, t_seq, start, data_axis):
     t_all = lax.all_gather(t_seq, data_axis, axis=0, tiled=True)
     start_all = lax.all_gather(start, data_axis, axis=0, tiled=True)
     name = loss_cfg.name
-    backend = getattr(loss_cfg, "sdtw_backend", "scan")
+    common = dict(gamma=loss_cfg.sdtw_gamma,
+                  backend=getattr(loss_cfg, "sdtw_backend", "scan"),
+                  dist=getattr(loss_cfg, "sdtw_dist", ""),
+                  bandwidth=getattr(loss_cfg, "sdtw_bandwidth", 0))
     if name == "cdtw":
-        return cdtw_batch_loss(v_all, t_all, gamma=loss_cfg.sdtw_gamma,
-                               backend=backend)
+        return cdtw_batch_loss(v_all, t_all, **common)
     if name == "sdtw_cidm":
         return sdtw_cidm_loss(v_all, t_all, start_all,
-                              gamma=loss_cfg.sdtw_gamma,
                               sigma=loss_cfg.cidm_sigma,
-                              lam=loss_cfg.cidm_lambda,
-                              backend=backend)
+                              lam=loss_cfg.cidm_lambda, **common)
     if name == "sdtw_negative":
-        return sdtw_negative_loss(v_all, t_all, gamma=loss_cfg.sdtw_gamma,
-                                  backend=backend)
+        return sdtw_negative_loss(v_all, t_all, **common)
     if name == "sdtw_3":
-        return sum(sdtw_3_loss(v_all, t_all, gamma=loss_cfg.sdtw_gamma,
-                               backend=backend))
+        return sum(sdtw_3_loss(v_all, t_all, **common))
     raise ValueError(f"unknown loss {name!r}")
 
 

@@ -72,3 +72,45 @@ def test_sdtw3_three_terms_and_gradients():
         assert np.isfinite(float(l))
     g = jax.grad(lambda a: sum(sdtw_3_loss(a, t, gamma=0.1)))(v)
     assert np.isfinite(np.asarray(g)).all()
+
+
+def test_dist_and_bandwidth_knobs_reach_the_dp():
+    """--loss.sdtw_dist / --loss.sdtw_bandwidth must actually change the
+    computation (they were once config-only dead knobs); '' keeps each
+    loss's reference default distance."""
+    from milnce_tpu.losses.dtw_losses import cdtw_batch_loss
+
+    v, t = _seqs(b=3, n=4, m=4, seed=11)
+    base = float(cdtw_batch_loss(v, t, gamma=0.1))
+    assert base == float(cdtw_batch_loss(v, t, gamma=0.1, dist="cosine"))
+    assert base != float(cdtw_batch_loss(v, t, gamma=0.1, dist="negative_dot"))
+    assert base != float(cdtw_batch_loss(v, t, gamma=0.1, bandwidth=1))
+    l3 = sdtw_3_loss(v, t, gamma=0.1)                     # negative_dot default
+    l3_override = sdtw_3_loss(v, t, gamma=0.1, dist="cosine")
+    assert float(l3[1]) != float(l3_override[1])
+
+
+def test_sequence_loss_threads_config_knobs():
+    """The train-step dispatcher forwards dist/bandwidth from LossConfig."""
+    from jax.sharding import Mesh
+    from milnce_tpu.config import LossConfig
+    from milnce_tpu.train.step import _sequence_loss
+    import jax as _jax
+    from jax.sharding import PartitionSpec as P
+
+    v, t = _seqs(b=8, n=4, m=4, seed=12)
+    start = jnp.zeros((8,))
+    mesh = Mesh(np.asarray(_jax.devices()), ("data",))
+
+    def run(cfg):
+        fn = _jax.shard_map(
+            lambda a, b_, s: _sequence_loss(cfg, a, b_, s, "data"),
+            mesh=mesh, in_specs=(P("data"), P("data"), P("data")),
+            out_specs=P(), check_vma=False)
+        return float(fn(v, t, start))
+
+    base = run(LossConfig(name="cdtw", sdtw_gamma=0.1))
+    banded = run(LossConfig(name="cdtw", sdtw_gamma=0.1, sdtw_bandwidth=1))
+    distd = run(LossConfig(name="cdtw", sdtw_gamma=0.1,
+                           sdtw_dist="negative_dot"))
+    assert base != banded and base != distd

@@ -184,3 +184,29 @@ def test_auto_backend_dispatch(monkeypatch):
 
     with np.testing.assert_raises(Exception):
         SoftDTW(backend="cuda")  # the reference's backend name is invalid
+
+
+def test_bandwidth_narrower_than_length_gap_rejected():
+    """A band that cannot cover |N-M| silently degenerates every value to
+    the BIG sentinel (finite -> invisible to the NaN guard); it must be a
+    loud static error on both backends."""
+    import pytest
+
+    from milnce_tpu.ops.softdtw_pallas import softdtw_pallas
+
+    D = jnp.ones((2, 10, 4), jnp.float32)
+    with pytest.raises(ValueError, match="bandwidth"):
+        softdtw_scan(D, 1.0, bandwidth=3)
+    with pytest.raises(ValueError, match="bandwidth"):
+        softdtw_pallas(D, 1.0, 3)
+    # a covering band is fine
+    assert np.isfinite(float(softdtw_scan(D, 1.0, bandwidth=6)[0]))
+
+
+def test_unknown_dist_func_named_error():
+    import pytest
+
+    from milnce_tpu.ops.softdtw import SoftDTW
+
+    with pytest.raises(ValueError, match="sdtw_dist"):
+        SoftDTW(dist_func="negative-dot")
