@@ -80,7 +80,10 @@ class LossConfig:
                                         # otherwise (BENCH_SOFTDTW.md;
                                         # reference always ran CUDA,
                                         # loss.py:26-97)
-    sdtw_gamma: float = 0.1             # loss.py:38,74,97 (cdtw uses 1e-5, loss.py:26)
+    sdtw_gamma: Optional[float] = None  # None = each loss's reference
+                                        # default: 1e-5 for cdtw (loss.py:
+                                        # 26), 0.1 for the sdtw_* family
+                                        # (loss.py:38,74,97)
     sdtw_dist: str = ""                 # '' = each loss's reference default
                                         # (cosine for cdtw/cidm/negative,
                                         # negative_dot for sdtw_3 — loss.py:

@@ -42,7 +42,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from milnce_tpu.ops.softdtw import BIG, skew_cost, softmin3
+from milnce_tpu.ops.softdtw import (BIG, check_bandwidth, skew_cost,
+                                    softmin3)
 
 
 def _softdtw_sp_local(D_local: jax.Array, n: int, m: int, gamma,
@@ -128,6 +129,7 @@ def softdtw_seq_parallel(D: jax.Array, gamma: float, mesh: Mesh,
     input dtype: the BIG-sentinel border arithmetic needs f32 range
     (bfloat16 saturates), unlike the in-dtype scan golden."""
     bsz, n, m = D.shape
+    check_bandwidth(n, m, int(bandwidth))
     p_count = mesh.shape[axis_name]
     k = -(-n // p_count)
     D_pad = jnp.pad(D.astype(jnp.float32), ((0, 0), (0, k * p_count - n),

@@ -46,10 +46,13 @@ def _sequence_loss(loss_cfg, v_seq, t_seq, start, data_axis):
     t_all = lax.all_gather(t_seq, data_axis, axis=0, tiled=True)
     start_all = lax.all_gather(start, data_axis, axis=0, tiled=True)
     name = loss_cfg.name
-    common = dict(gamma=loss_cfg.sdtw_gamma,
-                  backend=getattr(loss_cfg, "sdtw_backend", "scan"),
+    common = dict(backend=getattr(loss_cfg, "sdtw_backend", "scan"),
                   dist=getattr(loss_cfg, "sdtw_dist", ""),
                   bandwidth=getattr(loss_cfg, "sdtw_bandwidth", 0))
+    if loss_cfg.sdtw_gamma is not None:
+        # None = each loss function's own reference-default gamma
+        # (cdtw 1e-5, sdtw_* 0.1 — encoded in their signatures)
+        common["gamma"] = loss_cfg.sdtw_gamma
     if name == "cdtw":
         return cdtw_batch_loss(v_all, t_all, **common)
     if name == "sdtw_cidm":

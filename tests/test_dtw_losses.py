@@ -114,3 +114,31 @@ def test_sequence_loss_threads_config_knobs():
     distd = run(LossConfig(name="cdtw", sdtw_gamma=0.1,
                            sdtw_dist="negative_dot"))
     assert base != banded and base != distd
+
+
+def test_sequence_loss_per_loss_gamma_defaults():
+    """sdtw_gamma=None resolves to each loss's reference default: 1e-5
+    for cdtw (loss.py:26), 0.1 for the sdtw_* family (loss.py:38,74,97);
+    an explicit value overrides."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    import jax as _jax
+    from milnce_tpu.config import LossConfig
+    from milnce_tpu.train.step import _sequence_loss
+
+    v, t = _seqs(b=8, n=4, m=4, seed=13)
+    start = jnp.zeros((8,))
+    mesh = Mesh(np.asarray(_jax.devices()), ("data",))
+
+    def run(cfg):
+        fn = _jax.shard_map(
+            lambda a, b_, s: _sequence_loss(cfg, a, b_, s, "data"),
+            mesh=mesh, in_specs=(P("data"), P("data"), P("data")),
+            out_specs=P(), check_vma=False)
+        return float(fn(v, t, start))
+
+    assert run(LossConfig(name="cdtw")) == run(
+        LossConfig(name="cdtw", sdtw_gamma=1e-5))
+    assert run(LossConfig(name="cdtw")) != run(
+        LossConfig(name="cdtw", sdtw_gamma=0.1))
+    assert run(LossConfig(name="sdtw_3")) == run(
+        LossConfig(name="sdtw_3", sdtw_gamma=0.1))

@@ -71,3 +71,14 @@ def test_long_sequence_beyond_reference_cap():
     want = np.asarray(softdtw_scan(D, 0.5))
     got = np.asarray(softdtw_seq_parallel(D, 0.5, _mesh()))
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_sp_bandwidth_narrower_than_length_gap_rejected():
+    import pytest
+
+    from milnce_tpu.ops.softdtw_sp import softdtw_seq_parallel
+
+    mesh = Mesh(np.asarray(jax.devices()), ("data",))
+    D = jnp.ones((2, 10, 4), jnp.float32)
+    with pytest.raises(ValueError, match="bandwidth"):
+        softdtw_seq_parallel(D, 1.0, mesh, bandwidth=3)
