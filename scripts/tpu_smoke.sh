@@ -1,0 +1,33 @@
+#!/bin/bash
+# End-to-end TPU measurement queue: probe -> bench -> train-loop
+# cross-check.  Safe on a flaky accelerator: the probe runs a REAL tiny
+# jitted execute in a bounded subprocess first (init alone can succeed
+# on a wedged tunnel whose first execute hangs), and nothing here kills
+# a live TPU client mid-execute.
+#
+#   bash scripts/tpu_smoke.sh
+#
+# Outputs: BENCH_NOTES.md rewritten by bench.py, one JSON line on
+# stdout, and a 12-step batch-256 bf16 training-loop run whose logged
+# clips/s should roughly agree with the bench step at the same batch.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+REPO="$PWD"
+
+echo "=== probe ==="
+timeout 240 python -c "import jax, jax.numpy as jnp; print(float(jax.jit(lambda: jnp.ones(4).sum())()))" \
+  || { echo "accelerator unreachable — aborting (bench.py alone would fall back to CPU)"; exit 1; }
+
+echo "=== bench ==="
+MILNCE_BENCH_TPU_TIMEOUT="${MILNCE_BENCH_TPU_TIMEOUT:-3000}" python bench.py
+
+echo "=== train-loop cross-check (batch 256, 12 steps, synthetic) ==="
+RUNDIR="$(mktemp -d)"
+cd "$RUNDIR"
+PYTHONPATH="$REPO" python -m milnce_tpu.train.cli --preset small \
+  --data.synthetic true --data.synthetic_num_samples 3072 \
+  --data.num_frames 16 --data.max_words 20 \
+  --train.batch_size 256 --model.dtype bfloat16 \
+  --train.max_steps 12 --train.n_display 4 \
+  | grep -E "Training loss|Throughput|done:"
+echo "=== done (run dir: $RUNDIR) ==="
