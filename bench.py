@@ -149,6 +149,7 @@ def _step_flops(step_fn, args):
 def _bench_config(dtype: str, batch: int, frames: int, size: int,
                   words: int, k: int, remat: bool,
                   inner: int = 1, s2d: bool = False,
+                  conv_impl: str = "native",
                   peak: float | None = None, flops_hint: float | None = None):
     """Time the full train step at one operating point.
 
@@ -170,6 +171,7 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
     cfg.model.dtype = dtype
     cfg.model.remat = remat
     cfg.model.space_to_depth = s2d
+    cfg.model.conv_impl = conv_impl
     model = build_model(cfg.model)
     mesh = build_mesh(cfg.parallel)
 
@@ -274,6 +276,7 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
         "batch": batch,
         "remat": remat,
         "s2d": s2d,
+        "conv_impl": conv_impl,
         "inner": inner,
         "step_ms": round(dt / inner * 1e3, 2),
         "clips_per_sec_per_chip": round(batch * inner / dt / n_chips, 3),
@@ -294,7 +297,9 @@ def _make_record(best, frames, size, on_tpu, kind):
     out = {
         "metric": f"train_step clips/sec/chip ({frames}f@{size}, "
                   f"{best['dtype']}, batch {best['batch']}"
-                  + (", s2d stem" if best.get("s2d") else "") + ")",
+                  + (", s2d stem" if best.get("s2d") else "")
+                  + (", fold2d convs"
+                     if best.get("conv_impl") == "fold2d" else "") + ")",
         "value": value,
         "unit": "clips/sec/chip",
         # ratio vs the recorded TPU anchor — only meaningful on TPU (a
@@ -333,6 +338,10 @@ def run_bench(on_tpu: bool):
     # training used) — densifies conv1, the stage most starved on the
     # 128-wide MXU (see BENCH_NOTES.md headroom notes)
     s2d = os.environ.get("MILNCE_BENCH_S2D") == "1"
+    # conv lowering for the sweep: 'native' 3D convs or 'fold2d' (2D-conv
+    # decomposition, models/conv3d.py); a fold2d row is also auto-measured
+    # at the winning operating point (opt out: MILNCE_BENCH_FOLD2D=0)
+    conv_impl = os.environ.get("MILNCE_BENCH_CONV", "native")
     if on_tpu:
         frames, size, words, k = 16, 224, 20, 5
         # differenced W(k2)-W(k1) timing cancels dispatch latency, so the
@@ -373,7 +382,7 @@ def run_bench(on_tpu: bool):
         for batch in batches:
             try:
                 r = _bench_config(dtype, batch, frames, size, words, k,
-                                  remat, inner, s2d, peak=peak,
+                                  remat, inner, s2d, conv_impl, peak=peak,
                                   flops_hint=hint(dtype, remat, s2d, batch))
             except Exception as exc:
                 if _is_oom(exc) and not remat:
@@ -388,7 +397,8 @@ def run_bench(on_tpu: bool):
                     try:
                         r = _bench_config(dtype, batch, frames, size, words,
                                           k, remat=True, inner=inner,
-                                          s2d=s2d, peak=peak,
+                                          s2d=s2d, conv_impl=conv_impl,
+                                          peak=peak,
                                           flops_hint=hint(dtype, True, s2d,
                                                           batch))
                     except Exception as exc2:
@@ -425,23 +435,39 @@ def run_bench(on_tpu: bool):
             "(see stderr for per-config errors)")
     best = max(results, key=lambda r: r["clips_per_sec_per_chip"])
 
-    # One space_to_depth row at the winning operating point: the original
-    # TPU training used the s2d stem (s3dg.py:214-215, 248-253) precisely
-    # because it densifies conv1 for the MXU — always measure it so the
-    # comparison lands in every TPU BENCH_NOTES (opt out: MILNCE_BENCH_S2D=0).
-    if on_tpu and not s2d and os.environ.get("MILNCE_BENCH_S2D") != "0":
+    def extra_row(label, **overrides):
+        """One comparison row at the winning operating point, with the
+        same record/interim-emit protocol as the sweep rows."""
+        nonlocal best
         try:
+            kw = dict(remat=best["remat"], inner=inner,
+                      s2d=best.get("s2d", False), conv_impl=conv_impl,
+                      peak=peak)
+            kw.update(overrides)
             r = _bench_config(best["dtype"], best["batch"], frames, size,
-                              words, k, best["remat"], inner, s2d=True,
-                              peak=peak)
+                              words, k, **kw)
             if peak and r["flops_per_sec"]:
                 r["mfu"] = round(r["flops_per_sec"] / (peak * len(devices)), 4)
             _note(f"bench: {r}")
             results.append(r)
-            best = max(results, key=lambda r: r["clips_per_sec_per_chip"])
+            best = max(results, key=lambda x: x["clips_per_sec_per_chip"])
+            _emit(_make_record(best, frames, size, on_tpu, kind))
         except Exception as exc:
-            _note(f"bench: s2d row failed ({type(exc).__name__}: {exc}) — "
-                  "keeping plain-stem results")
+            _note(f"bench: {label} row failed ({type(exc).__name__}: {exc})"
+                  " — keeping prior results")
+
+    # space_to_depth row at the winning operating point: the original TPU
+    # training used the s2d stem (s3dg.py:214-215, 248-253) precisely
+    # because it densifies conv1 for the MXU — always measure the
+    # comparison (opt out: MILNCE_BENCH_S2D=0).
+    if on_tpu and not s2d and os.environ.get("MILNCE_BENCH_S2D") != "0":
+        extra_row("s2d", s2d=True)
+    # fold2d row: same math lowered as 2D convs (models/conv3d.py) — if
+    # XLA's 3D-conv tiling is the MFU sink (PERF.md headroom reading)
+    # this row shows it directly.
+    if (on_tpu and conv_impl == "native"
+            and os.environ.get("MILNCE_BENCH_FOLD2D") != "0"):
+        extra_row("fold2d", conv_impl="fold2d")
 
     _write_notes(results, best, kind, on_tpu, len(devices))
     return _make_record(best, frames, size, on_tpu, kind)
@@ -461,11 +487,12 @@ def _write_notes(results, best, kind, on_tpu, n_chips):
                  f"- chosen operating point: dtype={best['dtype']} "
                  f"batch={best['batch']} remat={best['remat']} -> "
                  f"{best['clips_per_sec_per_chip']} clips/sec/chip",
-                 "", "| dtype | batch | remat | s2d | step_ms | clips/s/chip | MFU |",
-                 "|---|---|---|---|---|---|---|"]
+                 "", "| dtype | batch | remat | s2d | conv | step_ms | clips/s/chip | MFU |",
+                 "|---|---|---|---|---|---|---|---|"]
         for r in results:
             lines.append(f"| {r['dtype']} | {r['batch']} | {r['remat']} | "
                          f"{r.get('s2d', False)} | "
+                         f"{r.get('conv_impl', 'native')} | "
                          f"{r['step_ms']} | {r['clips_per_sec_per_chip']} | "
                          f"{r.get('mfu', '-')} |")
         lines += ["", "Roofline context for these numbers: PERF.md "

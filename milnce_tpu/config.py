@@ -64,6 +64,10 @@ class ModelConfig:
     sync_batchnorm: bool = False        # cross-replica BN (original TPU run); False = local
                                         # BN for parity with the GPU reference (README.md:13)
     dtype: str = "float32"              # activation dtype ('bfloat16' for MXU speed)
+    conv_impl: str = "native"           # 'native' 3D convs | 'fold2d' (same
+                                        # math as 2D convs — layout XLA:TPU's
+                                        # conv emitter is tuned for; see
+                                        # models/conv3d.py, identical params)
     remat: bool = False                 # rematerialize Inception blocks
                                         # (jax.checkpoint) to fit big batches
 

@@ -48,6 +48,7 @@ def build_model(cfg: ModelConfig, bn_axis_name: str | None = None) -> S3D:
         text_hidden_dim=cfg.text_hidden_dim,
         weight_init=cfg.weight_init,
         bn_axis_name=bn_axis_name if cfg.sync_batchnorm else None,
+        conv_impl=cfg.conv_impl,
         embedding_init=embedding_init,
         remat=cfg.remat,
         dtype=jnp.dtype(cfg.dtype),

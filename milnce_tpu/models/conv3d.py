@@ -1,0 +1,107 @@
+"""3D convolution with selectable TPU lowering.
+
+The whole S3D-G trunk (reference s3dg.py:61-111) is built from three
+conv shapes: pointwise ``(1,1,1)``, spatial ``(1,k,k)``, and temporal
+``(k,1,1)`` — plus the one full ``(3,7,7)`` stem conv.  ``impl`` picks
+how they reach the MXU:
+
+- ``"native"``: one ``lax.conv_general_dilated`` with 3 spatial dims
+  (NDHWC).  XLA:TPU supports it, but its 3D-conv tiling with tiny
+  temporal extents (T' = 8..2 deep in the trunk) is far less tuned than
+  the 2D path.
+- ``"fold2d"``: the same math expressed as 2D convolutions, the layout
+  XLA:TPU's conv emitter is actually optimized for — spatial kernels
+  fold T into the batch dim ((B,T,H,W,C) -> (B*T,H,W,C)), temporal
+  kernels fold (H,W) into one spatial dim ((B,T,H*W,C)), and a full
+  (kt,kh,kw) kernel decomposes into kt temporally-shifted 2D convs
+  summed (valid because conv is linear in the kernel taps).
+
+The parameter is a single ``kernel`` of shape ``(t, h, w, in, out)``
+in BOTH impls, so checkpoints swap freely and the flag is purely a
+performance choice (``scripts/stage_probe.py --conv_impl`` measures it
+per stage).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from flax.linen.dtypes import promote_dtype
+from jax import lax
+
+Array = jax.Array
+
+_DN3D = ("NDHWC", "DHWIO", "NDHWC")
+_DN2D = ("NHWC", "HWIO", "NHWC")
+
+
+class Conv3D(nn.Module):
+    """Bias-free 3D conv with explicit symmetric padding per dim,
+    matching the torch ``nn.Conv3d`` semantics every trunk conv uses."""
+
+    features: int
+    kernel_size: Sequence[int]
+    strides: Sequence[int] = (1, 1, 1)
+    padding: Sequence[int] = (0, 0, 0)
+    impl: str = "native"                  # 'native' | 'fold2d'
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        kt, kh, kw = (int(v) for v in self.kernel_size)
+        st, sh, sw = (int(v) for v in self.strides)
+        pt, ph, pw = (int(v) for v in self.padding)
+        kernel = self.param("kernel", self.kernel_init,
+                            (kt, kh, kw, x.shape[-1], self.features),
+                            jnp.float32)
+        x, kernel = promote_dtype(x, kernel, dtype=self.dtype)
+
+        if self.impl == "native":
+            return lax.conv_general_dilated(
+                x, kernel, (st, sh, sw), [(pt, pt), (ph, ph), (pw, pw)],
+                dimension_numbers=_DN3D)
+        if self.impl != "fold2d":
+            raise ValueError(f"unknown conv impl {self.impl!r}")
+
+        def conv2d(y, kern, strides, pads):
+            return lax.conv_general_dilated(y, kern, strides, pads,
+                                            dimension_numbers=_DN2D)
+
+        b = x.shape[0]
+        if kt == 1:
+            # spatial/pointwise: T is inert -> fold it into batch
+            assert pt == 0, "temporal padding with a 1-tap temporal kernel"
+            if st > 1:
+                x = x[:, ::st]
+            t = x.shape[1]
+            y = conv2d(x.reshape((b * t,) + x.shape[2:]), kernel[0],
+                       (sh, sw), [(ph, ph), (pw, pw)])
+            return y.reshape((b, t) + y.shape[1:])
+        if kh == 1 and kw == 1:
+            # temporal: (H,W) are inert -> fold into one spatial dim
+            assert ph == 0 and pw == 0, (
+                "spatial padding with a 1-tap spatial kernel")
+            if sh > 1 or sw > 1:
+                x = x[:, :, ::sh, ::sw]
+            _, t, h, w, c = x.shape
+            y = conv2d(x.reshape(b, t, h * w, c),
+                       kernel.reshape(kt, 1, c, self.features),
+                       (st, 1), [(pt, pt), (0, 0)])
+            return y.reshape(b, y.shape[1], h, w, self.features)
+        # full (kt,kh,kw) kernel (the conv1 stem): kt shifted 2D convs
+        # summed — conv is linear in the kernel taps, so
+        # out[t'] = sum_dt conv2d(x[st*t' + dt - pt], kernel[dt]).
+        xp = jnp.pad(x, ((0, 0), (pt, pt), (0, 0), (0, 0), (0, 0)))
+        t_out = (x.shape[1] + 2 * pt - kt) // st + 1
+        out = None
+        for dt in range(kt):
+            xs = lax.slice_in_dim(xp, dt, dt + st * (t_out - 1) + 1, st,
+                                  axis=1)
+            y = conv2d(xs.reshape((b * t_out,) + xs.shape[2:]), kernel[dt],
+                       (sh, sw), [(ph, ph), (pw, pw)])
+            out = y if out is None else out + y
+        return out.reshape((b, t_out) + out.shape[1:])

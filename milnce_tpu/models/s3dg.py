@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 from jax import lax
 
+from milnce_tpu.models.conv3d import Conv3D
 from milnce_tpu.models.initializers import (kernel_init_for,
                                             torch_bias,
                                             torch_default_kernel)
@@ -87,6 +88,7 @@ class STConv3D(nn.Module):
     separable: bool = False
     bn_axis_name: Optional[str] = None
     kernel_init: Callable = nn.initializers.lecun_normal()
+    conv_impl: str = "native"
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -96,10 +98,10 @@ class STConv3D(nn.Module):
         p = _triple(self.padding)
 
         def conv(y, feat, kern, stride, pad, name):
-            return nn.Conv(
-                feat, kernel_size=kern, strides=stride,
-                padding=[(pp, pp) for pp in pad], use_bias=False,
-                kernel_init=self.kernel_init, dtype=self.dtype, name=name,
+            return Conv3D(
+                feat, kernel_size=kern, strides=stride, padding=pad,
+                impl=self.conv_impl, kernel_init=self.kernel_init,
+                dtype=self.dtype, name=name,
             )(y)
 
         def bn(y, name):
@@ -139,6 +141,7 @@ class InceptionBlock(nn.Module):
     gating: bool = True
     bn_axis_name: Optional[str] = None
     kernel_init: Callable = nn.initializers.lecun_normal()
+    conv_impl: str = "native"
     dtype: Any = jnp.float32
 
     @property
@@ -149,7 +152,8 @@ class InceptionBlock(nn.Module):
     @nn.compact
     def __call__(self, x: Array, train: bool = False) -> Array:
         common = dict(bn_axis_name=self.bn_axis_name,
-                      kernel_init=self.kernel_init, dtype=self.dtype)
+                      kernel_init=self.kernel_init,
+                      conv_impl=self.conv_impl, dtype=self.dtype)
         b0 = STConv3D(self.num_outputs_0_0a, (1, 1, 1), name="conv_b0",
                       **common)(x, train)
         b1 = STConv3D(self.num_outputs_1_0a, (1, 1, 1), name="conv_b1_a",
@@ -233,6 +237,8 @@ class S3D(nn.Module):
     text_hidden_dim: int = 2048
     weight_init: str = "uniform"
     bn_axis_name: Optional[str] = None
+    conv_impl: str = "native"           # 'native' 3D convs | 'fold2d'
+                                        # (see models/conv3d.py)
     embedding_init: Optional[Callable] = None
     remat: bool = False                 # rematerialize Inception blocks to
                                         # trade FLOPs for HBM at big batches
@@ -243,7 +249,7 @@ class S3D(nn.Module):
             f"inception_blocks must be in [1, 9], got {self.inception_blocks}")
         ki = kernel_init_for(self.weight_init)
         common = dict(bn_axis_name=self.bn_axis_name, kernel_init=ki,
-                      dtype=self.dtype)
+                      conv_impl=self.conv_impl, dtype=self.dtype)
         block_cls = (nn.remat(InceptionBlock, static_argnums=(2,))
                      if self.remat else InceptionBlock)
         if self.use_space_to_depth:

@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import json
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
@@ -32,16 +31,13 @@ def timed_run(fn, D, n_iters: int = 256):
     gradients + a timed fwd / fwd+bwd measurement.  Returns
     (fwd_s, bwd_s, value, grad).
 
-    Remote backends (the axon TPU tunnel) add ~70 ms of latency per
-    dispatch and their ``block_until_ready`` resolves well before the
-    device work is observable — naive per-dispatch timing reports
-    latency, not kernel time (observed: the same kernel "measured"
-    11.5 ms singly and 5 us chained).  So: run k executions inside ONE
-    XLA program (a ``lax.scan`` whose carry perturbs the input by
-    +-1e-30, defeating CSE), materialize the scalar result on host, and
-    report the *difference* (T(k_small+n_iters) - T(k_small)) / n_iters,
-    which cancels the fixed dispatch cost."""
-    from jax import lax
+    Timing protocol: ``milnce_tpu.utils.timing.chained_seconds`` (chained
+    scan with a CSE-defeating carry perturbation, differenced between two
+    chain lengths, host-materialized — the axon tunnel's
+    ``block_until_ready`` resolves early and each dispatch costs ~70 ms
+    of latency, so naive per-dispatch timing reports latency, not kernel
+    time)."""
+    from milnce_tpu.utils.timing import chained_seconds
 
     value_and_grad = jax.jit(jax.value_and_grad(lambda d: jnp.sum(fn(d))))
 
@@ -49,36 +45,12 @@ def timed_run(fn, D, n_iters: int = 256):
     value, grad = value_and_grad(D)
     jax.block_until_ready((value, grad))
 
-    def chain(step, k):
-        def run(d):
-            def body(acc, _):
-                return acc + step(d + acc * 1e-30), None
-
-            return lax.scan(body, jnp.float32(0.0), None, length=k)[0]
-
-        return jax.jit(run)
-
-    def measure(step, reps: int = 2):
-        k1 = 16
-        k2 = k1 + n_iters
-        f1, f2 = chain(step, k1), chain(step, k2)
-        float(f1(D)), float(f2(D))              # compile + warm
-        t1 = min(_wall(f1, D) for _ in range(reps))
-        t2 = min(_wall(f2, D) for _ in range(reps))
-        return max(t2 - t1, 0.0) / n_iters
-
-    t_fwd = measure(lambda d: jnp.sum(fn(d)))
+    t_fwd = chained_seconds(lambda d: jnp.sum(fn(d)), D, n_iters)
     # grad() re-runs the forward, so each iteration is one fwd+bwd pass
-    t_bwd = measure(lambda d: jnp.sum(jax.grad(
-        lambda x: jnp.sum(fn(x)))(d)))
+    t_bwd = chained_seconds(lambda d: jnp.sum(jax.grad(
+        lambda x: jnp.sum(fn(x)))(d)), D, n_iters)
 
     return t_fwd, t_bwd, np.asarray(value), np.asarray(grad)
-
-
-def _wall(f, D) -> float:
-    t0 = time.perf_counter()
-    float(f(D))                                 # host materialization
-    return time.perf_counter() - t0
 
 
 def profile(batch_size: int, seq_len_a: int, seq_len_b: int, dims: int,

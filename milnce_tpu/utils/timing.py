@@ -1,0 +1,56 @@
+"""On-device timing that survives a lying remote backend.
+
+Remote tunnels (the axon TPU relay) add seconds of per-dispatch latency
+and their ``block_until_ready`` can resolve before device work is
+observable — naive per-dispatch timing reports latency, not kernel time
+(observed: the same kernel "measured" 11.5 ms singly and 5 us chained).
+The protocol here, shared by ``bench.py``-adjacent harnesses
+(``milnce_tpu/ops/softdtw_profile.py``, ``scripts/stage_probe.py``):
+
+1. run ``k`` executions inside ONE XLA program (a ``lax.scan`` whose
+   carry perturbs the input by ±1e-30, defeating CSE; the perturbation
+   is cast to the input dtype so bf16 workloads aren't silently promoted
+   to f32);
+2. materialize the scalar result ON HOST (a device->host transfer of the
+   computed value cannot resolve early);
+3. report the difference ``(T(k1+n) - T(k1)) / n``, which cancels the
+   fixed dispatch cost.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def chained_seconds(step: Callable, x, n_iters: int, k1: int = 16,
+                    reps: int = 2) -> float:
+    """Seconds per execution of ``step(x) -> scalar`` under the protocol
+    above.  ``step`` must be a pure jittable function of one array."""
+
+    def chain(k):
+        def run(d):
+            def body(acc, _):
+                bump = (acc * 1e-30).astype(d.dtype)
+                return acc + jnp.asarray(step(d + bump),
+                                         jnp.float32), None
+
+            return lax.scan(body, jnp.float32(0.0), None, length=k)[0]
+
+        return jax.jit(run)
+
+    f1, f2 = chain(k1), chain(k1 + n_iters)
+    float(f1(x)), float(f2(x))                  # compile + warm
+    t1 = min(_wall(f1, x) for _ in range(reps))
+    t2 = min(_wall(f2, x) for _ in range(reps))
+    return max(t2 - t1, 0.0) / n_iters
+
+
+def _wall(f, x) -> float:
+    t0 = time.perf_counter()
+    float(f(x))                                 # host materialization
+    return time.perf_counter() - t0

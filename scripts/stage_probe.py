@@ -1,0 +1,226 @@
+"""Per-stage on-device timing of the S3D-G trunk.
+
+BENCH_NOTES.md records whole-train-step MFU far below the analytic
+roofline ceiling (PERF.md: weighted ceiling ~63%); this probe answers
+*where* the gap lives by timing every trunk stage (conv1, pools,
+conv_2b/2c, each Inception block, head) as its own jitted program on
+the real chip, with the same chained-scan + differenced +
+host-materialized timing the soft-DTW harness uses (the axon tunnel's
+``block_until_ready`` can resolve early and per-dispatch latency is
+seconds — ``milnce_tpu/ops/softdtw_profile.py:timed_run`` notes).
+
+Per stage it reports measured ms, the analytic roofline expectation at
+the same shape (FLOPs, bytes, and the min(MXU, HBM) time bound from
+``milnce_tpu/utils/roofline.py``), and the achieved fraction of that
+bound — a stage far under its own bound is a scheduling/tiling problem,
+not physics.
+
+    python scripts/stage_probe.py                  # bf16 batch 32
+    python scripts/stage_probe.py --batch 128 --dtype bfloat16
+    MILNCE_PROFILE_CPU=1 python scripts/stage_probe.py --batch 2 --size 64
+
+Writes one JSON line per stage to stdout and a summary table to
+``STAGE_PROBE.md`` (TPU runs only).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from bench import _probe_backend  # noqa: E402  (shared wedged-tunnel probe)
+
+
+def _timed(fn, x, n_iters: int) -> float:
+    """Seconds per fn(x) execution via the shared chained-scan protocol
+    (milnce_tpu.utils.timing); short k1 keeps per-stage compiles cheap."""
+    import jax.numpy as jnp
+
+    from milnce_tpu.utils.timing import chained_seconds
+
+    return chained_seconds(lambda d: jnp.sum(fn(d)), x, n_iters, k1=2)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--frames", type=int, default=16)
+    ap.add_argument("--size", type=int, default=224)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--conv_impl", default="native",
+                    choices=["native", "fold2d"])
+    ap.add_argument("--iters", type=int, default=8,
+                    help="chained executions per measurement")
+    args = ap.parse_args()
+
+    if os.environ.get("MILNCE_PROFILE_CPU") == "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    elif not _probe_backend():
+        print(json.dumps({"error": "accelerator unreachable; set "
+                          "MILNCE_PROFILE_CPU=1 for a CPU sanity run"}))
+        sys.exit(1)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(_REPO, "build", "jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from milnce_tpu.config import full_preset
+    from milnce_tpu.models.build import build_model
+    from milnce_tpu.models.s3dg import _tf_same_max_pool
+    from milnce_tpu.utils import roofline
+
+    cfg = full_preset()
+    cfg.model.dtype = args.dtype
+    cfg.model.conv_impl = args.conv_impl
+    model = build_model(cfg.model)
+    variables = model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((2, args.frames, args.size, args.size, 3), jnp.float32),
+        jnp.zeros((2, 6), jnp.int32))
+
+    dev_kind = getattr(jax.devices()[0], "device_kind",
+                       jax.devices()[0].platform)
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    # peak flops / HBM GB/s for the roofline bound (bench.py table)
+    from bench import _PEAK_FLOPS, _peak_flops
+
+    peak_flops = _peak_flops(str(dev_kind)) or max(_PEAK_FLOPS.values())
+    hbm_gbs = 820e9 if on_tpu else 50e9           # v5e HBM; CPU ~DDR
+
+    compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+
+    def stage_apply(method):
+        def fn(x):
+            return model.apply(variables, x, method=method)
+
+        return fn
+
+    block_names = [n for n, _ in roofline.INCEPTION_PLAN]
+
+    def block_stage(name):
+        def method(m, x):
+            return getattr(m, name)(x, False)
+
+        return stage_apply(method)
+
+    # (stage name, fn, pool applied to the input first)
+    stages = [
+        ("conv1", stage_apply(lambda m, x: m.conv1(x, False)), None),
+        ("maxpool_2a", lambda x: _tf_same_max_pool(x, (1, 3, 3), (1, 2, 2)),
+         None),
+        ("conv_2b", stage_apply(lambda m, x: m.conv_2b(x, False)), None),
+        ("conv_2c", stage_apply(lambda m, x: m.conv_2c(x, False)), None),
+        ("gating", stage_apply(lambda m, x: m.stem_gating(x)), None),
+        ("maxpool_3a", lambda x: _tf_same_max_pool(x, (1, 3, 3), (1, 2, 2)),
+         None),
+    ]
+    for idx, name in enumerate(block_names):
+        pool = roofline.POOLS_BEFORE.get(idx)
+        stages.append((name, block_stage(name), pool))
+
+    # analytic per-stage roofline at this shape
+    model_stages = roofline.s3d_video_stages(
+        args.batch, args.frames, args.size,
+        dtype_bytes=2 if args.dtype == "bfloat16" else 4)
+    flops_by_prefix = {}
+    bytes_by_prefix = {}
+    for st in model_stages:
+        prefix = st.name.split(".")[0]
+        flops_by_prefix[prefix] = flops_by_prefix.get(prefix, 0.0) + st.flops
+        bytes_by_prefix[prefix] = bytes_by_prefix.get(prefix, 0.0) + st.bytes
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(
+        rng.rand(args.batch, args.frames, args.size, args.size, 3)
+        .astype(np.float32)).astype(compute_dtype)
+
+    records = []
+    total_ms = 0.0
+    for name, fn, pool in stages:
+        if pool is not None:
+            x = _tf_same_max_pool(x, *pool)
+        t = _timed(fn, x, args.iters)
+        flops = flops_by_prefix.get(name, 0.0)
+        byts = bytes_by_prefix.get(name, 0.0)
+        bound_s = max(flops / peak_flops, byts / hbm_gbs) if byts else None
+        rec = {
+            "stage": name,
+            "in_shape": list(x.shape),
+            "ms": round(t * 1e3, 3),
+            "gflop": round(flops / 1e9, 2),
+            "tflops_per_s": round(flops / t / 1e12, 2) if t else None,
+            "pct_of_peak": round(100 * flops / t / peak_flops, 1) if t else None,
+            "roofline_ms": round(bound_s * 1e3, 3) if bound_s else None,
+            "x_over_roofline": (round(t / bound_s, 1)
+                                if bound_s and bound_s > 0 else None),
+        }
+        print(json.dumps(rec), flush=True)
+        records.append(rec)
+        total_ms += t * 1e3
+        x = jax.jit(fn)(x)              # advance to the next stage's input
+
+    # whole-trunk forward for reconciliation (sum of parts vs one program:
+    # the difference is what XLA's cross-stage fusion buys)
+    trunk = stage_apply(lambda m, v: m.forward_video(v))
+    x0 = jnp.asarray(
+        rng.rand(args.batch, args.frames, args.size, args.size, 3)
+        .astype(np.float32)).astype(compute_dtype)
+    t_trunk = _timed(trunk, x0, args.iters)
+    summary = {
+        "stage": "TRUNK_FWD(one program)",
+        "ms": round(t_trunk * 1e3, 3),
+        "sum_of_stage_ms": round(total_ms, 3),
+        "device": str(dev_kind),
+        "batch": args.batch,
+        "dtype": args.dtype,
+        "conv_impl": args.conv_impl,
+    }
+    print(json.dumps(summary), flush=True)
+    records.append(summary)
+
+    if on_tpu:
+        _write_md(records, args)
+
+
+def _write_md(records, args) -> None:
+    path = os.path.join(_REPO, "STAGE_PROBE.md")
+    lines = [
+        "# Stage probe (auto-written by scripts/stage_probe.py)", "",
+        f"- config: batch={args.batch} {args.frames}f@{args.size}^2 "
+        f"dtype={args.dtype} conv_impl={args.conv_impl}",
+        "- ms = chained-scan differenced host-materialized time; "
+        "roofline_ms = max(FLOPs/peak, bytes/HBM) analytic bound; "
+        "x_over = measured/bound (1.0 = at the roofline).", "",
+        "| stage | ms | GFLOP | TFLOP/s | % peak | roofline ms | x over |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r in records:
+        if "gflop" not in r:
+            continue
+        lines.append(
+            f"| {r['stage']} | {r['ms']} | {r['gflop']} | "
+            f"{r['tflops_per_s']} | {r['pct_of_peak']} | "
+            f"{r['roofline_ms']} | {r['x_over_roofline']} |")
+    tail = [r for r in records if r.get("stage", "").startswith("TRUNK")]
+    if tail:
+        lines += ["", f"Whole-trunk forward in ONE program: "
+                  f"{tail[0]['ms']} ms vs sum-of-stages "
+                  f"{tail[0]['sum_of_stage_ms']} ms "
+                  "(difference = cross-stage fusion + per-program overhead)."]
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+
+
+if __name__ == "__main__":
+    main()

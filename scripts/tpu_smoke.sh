@@ -21,6 +21,11 @@ timeout 240 python -c "import jax, jax.numpy as jnp; print(float(jax.jit(lambda:
 echo "=== bench ==="
 MILNCE_BENCH_TPU_TIMEOUT="${MILNCE_BENCH_TPU_TIMEOUT:-3000}" python bench.py
 
+echo "=== re-probe (the tunnel can wedge DURING bench: observed 2026-07-30,"
+echo "    remote_compile port refused connections 33 min after a healthy probe) ==="
+timeout 240 python -c "import jax, jax.numpy as jnp; print(float(jax.jit(lambda: jnp.ones(4).sum())()))" \
+  || { echo "accelerator lost mid-queue — skipping the train-loop cross-check (bench rows above are still valid)"; exit 0; }
+
 echo "=== train-loop cross-check (batch 256, 12 steps, synthetic) ==="
 RUNDIR="$(mktemp -d)"
 cd "$RUNDIR"

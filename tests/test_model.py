@@ -171,3 +171,51 @@ def test_sync_batchnorm_merges_stats_across_shards():
     np.testing.assert_allclose(
         np.asarray(stats_sharded["bn"]["var"]),
         np.asarray(mut_full["batch_stats"]["bn"]["var"]), rtol=1e-4)
+
+
+class TestConv3DFold2D:
+    """fold2d lowers every trunk conv shape as 2D convolutions with an
+    IDENTICAL parameter layout (models/conv3d.py) — outputs must match
+    the native 3D lowering to numerical noise."""
+
+    # (kernel, strides, padding) — every distinct conv shape in the trunk
+    SHAPES = [
+        ((1, 1, 1), (1, 1, 1), (0, 0, 0)),       # pointwise branches
+        ((1, 3, 3), (1, 1, 1), (0, 1, 1)),       # separable spatial
+        ((3, 1, 1), (1, 1, 1), (1, 0, 0)),       # separable temporal
+        ((1, 7, 7), (1, 2, 2), (0, 3, 3)),       # strided spatial
+        ((3, 7, 7), (2, 2, 2), (1, 3, 3)),       # conv1 stem (full 3D)
+        ((2, 4, 4), (1, 1, 1), (1, 2, 2)),       # s2d stem (even kernel)
+    ]
+
+    @pytest.mark.parametrize("kernel,strides,padding", SHAPES)
+    def test_matches_native(self, kernel, strides, padding):
+        from milnce_tpu.models.conv3d import Conv3D
+
+        x = jnp.asarray(np.random.RandomState(0)
+                        .randn(2, 5, 12, 12, 6).astype(np.float32))
+        kw = dict(features=8, kernel_size=kernel, strides=strides,
+                  padding=padding)
+        native = Conv3D(impl="native", **kw)
+        params = native.init(jax.random.PRNGKey(1), x)
+        ref = native.apply(params, x)
+        out = Conv3D(impl="fold2d", **kw).apply(params, x)
+        assert out.shape == ref.shape
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_full_model_parity(self):
+        """Whole S3D-G forward agrees across conv impls on the same
+        variables (the param trees are layout-identical by design)."""
+        video = jnp.asarray(np.random.RandomState(0)
+                            .rand(2, 4, 32, 32, 3).astype(np.float32))
+        text = jnp.zeros((2, 6), jnp.int32)
+        native = tiny_model()
+        variables = native.init(jax.random.PRNGKey(0), video, text)
+        v_ref, t_ref = native.apply(variables, video, text)
+        v_out, t_out = tiny_model(conv_impl="fold2d").apply(
+            variables, video, text)
+        np.testing.assert_allclose(np.asarray(v_out), np.asarray(v_ref),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(t_out), np.asarray(t_ref),
+                                   rtol=1e-4, atol=1e-4)
