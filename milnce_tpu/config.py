@@ -154,6 +154,12 @@ class TrainConfig:
     max_steps: Optional[int] = None     # stop (with a checkpoint) after N
                                         # optimizer steps — bounded smoke /
                                         # bench runs; None = run all epochs
+    grad_accum: int = 1                 # microbatches per optimizer step
+                                        # (two-pass embedding-cache MIL-NCE:
+                                        # FULL global-batch negatives at 1/M
+                                        # activation memory — how the
+                                        # reference's 8192-batch recipe runs
+                                        # on a small mesh; train/step.py)
 
 
 @dataclass

@@ -66,6 +66,106 @@ def _sequence_loss(loss_cfg, v_seq, t_seq, start, data_axis):
     raise ValueError(f"unknown loss {name!r}")
 
 
+def make_grad_cache_step(model, optimizer, mesh: Mesh,
+                         micro_batches: int, data_axis: str = "data",
+                         donate: bool = True):
+    """Two-pass embedding-cache MIL-NCE train step (GradCache-style).
+
+    Contrastive losses don't decompose across plain gradient-accumulation
+    microbatches — every clip must score against EVERY other clip in the
+    effective batch.  The reference solved this with hardware (global
+    batch 8192 across 64 TPUs, README.md:98-105); this step solves it in
+    one SPMD program so the same recipe runs on any mesh size:
+
+    1. embed all M microbatches under ``lax.scan`` (activations for one
+       microbatch live at a time);
+    2. compute the mesh-global MIL-NCE loss and its gradient w.r.t. the
+       CACHED embeddings — cheap, embeddings are (B, D);
+    3. re-forward each microbatch seeding its VJP with the cached
+       embedding gradients, accumulating parameter gradients.
+
+    Cost: one extra forward (the pass-2 re-forward) — the same trade
+    ``remat`` makes, but at 1/M activation memory with exact full-batch
+    negatives.  Each microbatch computes its own BatchNorm statistics, so
+    a microbatch behaves exactly like an extra data-parallel shard with
+    local BN (the reference's semantics, README.md:13):
+    ``M microbatches x N chips == 1 microbatch x M*N chips`` to float
+    tolerance (pinned in tests/test_train.py).
+    """
+    assert micro_batches > 1, "use make_train_step for micro_batches=1"
+    compute_dtype = jnp.dtype(getattr(model, "dtype", jnp.float32))
+
+    def local_step(state: TrainState, video_u8, text_ids, start):
+        del start
+        b = video_u8.shape[0]
+        assert b % micro_batches == 0, (b, micro_batches)
+        bm = b // micro_batches
+        k_rows = text_ids.shape[0] // b
+        vids = video_u8.reshape((micro_batches, bm) + video_u8.shape[1:])
+        txts = text_ids.reshape((micro_batches, bm * k_rows)
+                                + text_ids.shape[1:])
+
+        def fwd(params, batch_stats, vu8, tids):
+            video = vu8.astype(compute_dtype) / jnp.asarray(255, compute_dtype)
+            return model.apply({"params": params, "batch_stats": batch_stats},
+                               video, tids, train=True,
+                               mutable=["batch_stats"])
+
+        # pass 1: embed every microbatch, cache embeddings only
+        def embed_one(_, xs):
+            vu8, tids = xs
+            (v, t), mutated = fwd(state.params, state.batch_stats, vu8, tids)
+            return None, (v, t, mutated["batch_stats"])
+
+        _, (v_mb, t_mb, stats_mb) = lax.scan(embed_one, None, (vids, txts))
+        v_local = v_mb.reshape(b, -1)
+        t_local = t_mb.reshape(b * k_rows, -1)
+
+        # loss + gradients w.r.t. the cached embeddings (mesh-global
+        # negatives exactly as the single-pass step)
+        loss, (g_v, g_t) = jax.value_and_grad(
+            lambda v, t: milnce_loss(v, t, axis_name=data_axis),
+            argnums=(0, 1))(v_local, t_local)
+
+        # pass 2: re-forward each microbatch, seed its VJP with the
+        # cached embedding grads, accumulate parameter grads
+        g_v_mb = g_v.reshape(micro_batches, bm, -1)
+        g_t_mb = g_t.reshape(micro_batches, bm * k_rows, -1)
+
+        def grad_one(acc, xs):
+            vu8, tids, gv, gt = xs
+
+            def f(params):
+                (v, t), _ = fwd(params, state.batch_stats, vu8, tids)
+                return v, t
+
+            _, vjp = jax.vjp(f, state.params)
+            (g,) = vjp((gv, gt))
+            return jax.tree_util.tree_map(jnp.add, acc, g), None
+
+        zero = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+        grads, _ = lax.scan(grad_one, zero, (vids, txts, g_v_mb, g_t_mb))
+
+        grads = lax.psum(grads, data_axis)
+        # merge BN stats over microbatches then shards: a microbatch is a
+        # virtual shard, so mean-of-means matches the M*N-chip run
+        new_stats = jax.tree_util.tree_map(
+            lambda x: lax.pmean(jnp.mean(x, axis=0), data_axis), stats_mb)
+        updates, new_opt = optimizer.update(grads, state.opt_state,
+                                            state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return TrainState(step=state.step + 1, params=new_params,
+                          batch_stats=new_stats, opt_state=new_opt), loss
+
+    sharded = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(), P(data_axis), P(data_axis), P(data_axis)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
 def make_train_step(model, optimizer, mesh: Mesh, data_axis: str = "data",
                     donate: bool = True, loss_cfg=None, inner_steps: int = 1):
     """Build the jitted train step.
@@ -87,9 +187,13 @@ def make_train_step(model, optimizer, mesh: Mesh, data_axis: str = "data",
     costs seconds) so the measurement reflects device throughput.
     """
     loss_name = getattr(loss_cfg, "name", "milnce")
+    # normalize straight into the model's compute dtype: a bf16 model casts
+    # the video to bf16 at conv1 anyway (Conv3D promote_dtype), so an f32
+    # intermediate would only add HBM traffic on the largest activation
+    compute_dtype = jnp.dtype(getattr(model, "dtype", jnp.float32))
 
     def local_step(state: TrainState, video_u8, text_ids, start):
-        video = video_u8.astype(jnp.float32) / 255.0
+        video = video_u8.astype(compute_dtype) / jnp.asarray(255, compute_dtype)
 
         def loss_fn(params):
             variables = {"params": params, "batch_stats": state.batch_stats}
@@ -149,7 +253,8 @@ def make_video_embed_fn(model, mesh: Mesh, data_axis: str = "data",
     eval_hmdb.py:75).  video_u8 sharded on dim 0; returns sharded embeds."""
 
     def local(variables, video_u8):
-        video = video_u8.astype(jnp.float32) / 255.0
+        dt = jnp.dtype(getattr(model, "dtype", jnp.float32))
+        video = video_u8.astype(dt) / jnp.asarray(255, dt)
         return model.apply(variables, video, None, mode="video",
                            mixed5c=mixed5c)
 

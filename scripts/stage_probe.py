@@ -36,6 +36,28 @@ sys.path.insert(0, _REPO)
 from bench import _probe_backend  # noqa: E402  (shared wedged-tunnel probe)
 
 
+# HBM bandwidth (bytes/s) by device_kind substring — public figures,
+# companion to bench._PEAK_FLOPS; the roofline bound needs both axes to
+# track the device.
+_HBM_BW = {
+    "v6": 1640e9,       # Trillium / v6e
+    "v5p": 2765e9,
+    "v5e": 820e9,
+    "v5 lite": 820e9,
+    "v4": 1228e9,
+    "v3": 900e9,
+    "v2": 700e9,
+}
+
+
+def _hbm_bandwidth(device_kind: str) -> float:
+    kind = device_kind.lower()
+    for key, val in _HBM_BW.items():
+        if key in kind:
+            return val
+    return min(_HBM_BW.values())
+
+
 def _timed(fn, x, n_iters: int) -> float:
     """Seconds per fn(x) execution via the shared chained-scan protocol
     (milnce_tpu.utils.timing); short k1 keeps per-stage compiles cheap."""
@@ -96,7 +118,8 @@ def main() -> None:
     from bench import _PEAK_FLOPS, _peak_flops
 
     peak_flops = _peak_flops(str(dev_kind)) or max(_PEAK_FLOPS.values())
-    hbm_gbs = 820e9 if on_tpu else 50e9           # v5e HBM; CPU ~DDR
+    hbm_gbs = (_hbm_bandwidth(str(dev_kind)) if on_tpu
+               else 50e9)                          # CPU ~DDR
 
     compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
 

@@ -1,0 +1,31 @@
+#!/bin/bash
+# Wait for the TPU tunnel to heal, then run the whole measurement queue
+# once: tpu_smoke.sh (bench sweep + train-loop cross-check), then the
+# per-stage probe for both conv lowerings.
+#
+#   nohup bash scripts/tpu_watch.sh > /tmp/tpu_watch.log 2>&1 &
+#
+# Probes are bounded subprocess executes (the bench.py _probe_backend
+# recipe) spaced 10 min apart — a wedged relay has been observed to heal
+# on the scale of hours.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+for i in $(seq 1 60); do
+  if timeout 240 python -c "import jax, jax.numpy as jnp; print(float(jax.jit(lambda: jnp.ones(4).sum())()))" >/dev/null 2>&1; then
+    echo "=== tunnel healthy (probe $i, $(date -u +%H:%M)) — running measurement queue ==="
+    bash scripts/tpu_smoke.sh
+    echo "=== stage probe (native) ==="
+    python scripts/stage_probe.py --batch 64 --dtype bfloat16 --conv_impl native \
+      && cp STAGE_PROBE.md STAGE_PROBE_native.md
+    echo "=== stage probe (fold2d) ==="
+    python scripts/stage_probe.py --batch 64 --dtype bfloat16 --conv_impl fold2d \
+      && cp STAGE_PROBE.md STAGE_PROBE_fold2d.md
+    echo "=== measurement queue done ($(date -u +%H:%M)) ==="
+    exit 0
+  fi
+  echo "probe $i failed ($(date -u +%H:%M)); sleeping 600s"
+  sleep 600
+done
+echo "gave up after 60 probes (~10 h)"
+exit 1

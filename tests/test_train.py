@@ -319,3 +319,90 @@ def test_train_step_on_two_axis_mesh():
     assert mesh_2d.devices.shape == (4, 2)
     np.testing.assert_allclose(one_step(mesh_2d), one_step(mesh_1d),
                                rtol=1e-5)
+
+
+class TestGradCache:
+    """Two-pass embedding-cache MIL-NCE (train/step.py
+    make_grad_cache_step): M microbatches on N chips must equal one
+    microbatch on M*N chips — a microbatch IS a virtual data-parallel
+    shard (per-microbatch BN == the reference's per-GPU local BN)."""
+
+    def _setup(self, n_text_candidates=2):
+        import jax
+        import jax.numpy as jnp
+
+        from milnce_tpu.config import OptimConfig
+        from milnce_tpu.models import S3D
+        from milnce_tpu.train.schedule import build_schedule
+        from milnce_tpu.train.state import build_optimizer, create_train_state
+
+        model = S3D(num_classes=16, vocab_size=32, word_embedding_dim=8,
+                    text_hidden_dim=16, inception_blocks=1)
+        b, k, frames, size, words = 16, n_text_candidates, 4, 32, 5
+        rng = np.random.RandomState(0)
+        video = rng.randint(0, 255, (b, frames, size, size, 3), np.uint8)
+        text = rng.randint(0, 32, (b * k, words)).astype(np.int32)
+        variables = model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((2, frames, size, size, 3), jnp.float32),
+            jnp.zeros((2 * k, words), jnp.int32))
+        optim_cfg = OptimConfig(warmup_steps=2)
+        optimizer = build_optimizer(optim_cfg, build_schedule(optim_cfg, 10))
+        state = create_train_state(variables, optimizer)
+        return model, optimizer, state, video, text, b
+
+    def test_microbatch_equals_virtual_shard(self):
+        import jax
+        import numpy as onp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from milnce_tpu.train.step import (make_grad_cache_step,
+                                           make_train_step)
+
+        model, optimizer, state, video, text, b = self._setup()
+        devices = jax.devices()
+        assert len(devices) >= 8
+
+        # reference: plain step on an 8-device mesh
+        mesh8 = Mesh(onp.asarray(devices[:8]), ("data",))
+        step8 = make_train_step(model, optimizer, mesh8, donate=False)
+        sh8 = NamedSharding(mesh8, P("data"))
+        s8, loss8 = step8(state, jax.device_put(video, sh8),
+                          jax.device_put(text, sh8),
+                          jax.device_put(onp.zeros((b,), onp.float32), sh8))
+
+        # grad-cache: 2 microbatches on a 4-device mesh (same global batch)
+        mesh4 = Mesh(onp.asarray(devices[:4]), ("data",))
+        gc = make_grad_cache_step(model, optimizer, mesh4, micro_batches=2,
+                                  donate=False)
+        sh4 = NamedSharding(mesh4, P("data"))
+        s4, loss4 = gc(state, jax.device_put(video, sh4),
+                       jax.device_put(text, sh4),
+                       jax.device_put(onp.zeros((b,), onp.float32), sh4))
+
+        np.testing.assert_allclose(float(loss4), float(loss8), rtol=1e-5)
+        flat8 = jax.tree_util.tree_leaves(s8.params)
+        flat4 = jax.tree_util.tree_leaves(s4.params)
+        for a8, a4 in zip(flat8, flat4):
+            np.testing.assert_allclose(np.asarray(a4), np.asarray(a8),
+                                       rtol=2e-4, atol=2e-5)
+        stats8 = jax.tree_util.tree_leaves(s8.batch_stats)
+        stats4 = jax.tree_util.tree_leaves(s4.batch_stats)
+        for a8, a4 in zip(stats8, stats4):
+            np.testing.assert_allclose(np.asarray(a4), np.asarray(a8),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_loop_integration(self, tiny_cfg, tmp_path):
+        """grad_accum=2 trains through run_training end to end."""
+        from milnce_tpu.train.loop import run_training
+
+        import copy
+
+        cfg = copy.deepcopy(tiny_cfg)    # module-scoped fixture: don't mutate
+        cfg.train.checkpoint_root = str(tmp_path / "ckpt_gc")
+        cfg.train.grad_accum = 2
+        # per-shard batch must split into grad_accum microbatches
+        cfg.train.batch_size = 16
+        result = run_training(cfg, max_steps=2)
+        assert result.steps == 2
+        assert np.isfinite(result.last_loss)
