@@ -18,11 +18,18 @@ from milnce_tpu.train.state import TrainState
 
 
 class CheckpointManager:
-    def __init__(self, directory: str, keep: int = 10):
+    def __init__(self, directory: str, keep: int = 10, create: bool = True):
+        """``create=False`` opens read-only — export/inspection consumers
+        must not mkdir a mistyped run directory as a side effect."""
         directory = os.path.abspath(directory)
         options = ocp.CheckpointManagerOptions(
-            max_to_keep=keep, create=True, enable_async_checkpointing=True)
-        self._mgr = ocp.CheckpointManager(directory, options=options)
+            max_to_keep=keep, create=create, read_only=not create,
+            enable_async_checkpointing=True)
+        # the explicit handler also makes item_metadata() work without a
+        # restore template (restore_raw)
+        self._mgr = ocp.CheckpointManager(
+            directory, options=options,
+            item_handlers=ocp.StandardCheckpointHandler())
 
     def save(self, epoch: int, state: TrainState) -> None:
         self._mgr.save(epoch, args=ocp.args.StandardSave(state))
@@ -41,6 +48,39 @@ class CheckpointManager:
         if latest is None:
             return 0, template
         return latest, self.restore(latest, template)
+
+    def restore_raw(self, epoch: Optional[int] = None,
+                    subtrees: Optional[set] = None):
+        """Restore WITHOUT a caller-provided template: (epoch, tree).
+        For consumers that only need the arrays — e.g. exporting weights
+        to the reference's torch format — where building a TrainState
+        template would require knowing the run's model shapes.  The
+        shape/dtype template comes from the checkpoint's own metadata, so
+        a run saved on an 8-device mesh restores on a single-device host
+        (restore-as-saved would demand the original devices).
+
+        ``subtrees`` limits restore I/O to those top-level keys (e.g.
+        ``{'params', 'batch_stats'}`` — skipping a real run's Adam state
+        halves-to-thirds the bytes read); other keys restore as
+        ``ocp.PLACEHOLDER``."""
+        import jax
+
+        latest = epoch if epoch is not None else self.latest_epoch()
+        if latest is None:
+            raise FileNotFoundError("no checkpoint saved in this run dir")
+        meta = self._mgr.item_metadata(latest)
+        shard = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        is_arr = lambda x: hasattr(x, "shape")  # noqa: E731
+        template = jax.tree_util.tree_map(
+            lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=shard)
+            if is_arr(m) else m, meta, is_leaf=is_arr)
+        if subtrees is not None and isinstance(template, dict):
+            template = {
+                k: (v if k in subtrees else jax.tree_util.tree_map(
+                    lambda _: ocp.PLACEHOLDER, v, is_leaf=is_arr))
+                for k, v in template.items()}
+        return latest, self._mgr.restore(
+            latest, args=ocp.args.StandardRestore(template))
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()

@@ -60,6 +60,30 @@ def convert_checkpoint(src: str, dst: str) -> int:
     return epoch
 
 
+def export_checkpoint(src: str, dst: str) -> int:
+    """Orbax RUN directory -> a torch .pth the REFERENCE's eval scripts
+    load (the DDP ``{'epoch', 'state_dict': {'module.<k>': tensor}}``
+    flavor their format sniff expects, eval_msrvtt.py:21-26).  The
+    reverse of ``convert_checkpoint``: train here, evaluate there."""
+    import jax
+    import torch
+
+    from milnce_tpu.train.checkpoint import CheckpointManager
+    from milnce_tpu.utils.torch_convert import flax_to_torch_state_dict
+
+    epoch, tree = CheckpointManager(src, create=False).restore_raw(
+        subtrees={"params", "batch_stats"})
+    if not isinstance(tree, dict):      # a TrainState restored as object
+        tree = {"params": tree.params, "batch_stats": tree.batch_stats}
+    sd = flax_to_torch_state_dict(
+        {"params": jax.device_get(tree["params"]),
+         "batch_stats": jax.device_get(tree["batch_stats"])})
+    torch.save({"epoch": epoch,
+                "state_dict": {f"module.{k}": torch.from_numpy(
+                    np.array(v)) for k, v in sd.items()}}, dst)
+    return epoch
+
+
 def inspect(src: str) -> None:
     import torch
 
@@ -87,9 +111,21 @@ def main(argv=None):
     c = sub.add_parser("ckpt", help="torch checkpoint -> Orbax dir")
     c.add_argument("src")
     c.add_argument("dst")
+    e = sub.add_parser("export", help="Orbax run dir -> torch .pth "
+                                      "(reference eval scripts load it)")
+    e.add_argument("src")
+    e.add_argument("dst")
     i = sub.add_parser("inspect", help="list a torch checkpoint's tensors")
     i.add_argument("src")
+    for sp in (w, c, e, i):
+        sp.add_argument("--platform", default="",
+                        help="force a jax backend (e.g. 'cpu' — conversion "
+                             "needs no accelerator; same pin as the other CLIs)")
     args = p.parse_args(argv)
+    if getattr(args, "platform", ""):
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
     if args.cmd == "word2vec":
         v, d = convert_word2vec(args.src, args.dst)
@@ -97,6 +133,9 @@ def main(argv=None):
     elif args.cmd == "ckpt":
         epoch = convert_checkpoint(args.src, args.dst)
         print(f"wrote {args.dst}: run dir at epoch {epoch}")
+    elif args.cmd == "export":
+        epoch = export_checkpoint(args.src, args.dst)
+        print(f"wrote {args.dst}: torch checkpoint at epoch {epoch}")
     else:
         inspect(args.src)
 

@@ -86,6 +86,62 @@ def torch_state_dict_to_flax(state_dict: Mapping[str, np.ndarray]) -> dict:
     return {"params": params, "batch_stats": stats}
 
 
+def flax_to_torch_state_dict(variables: Mapping) -> dict[str, np.ndarray]:
+    """Inverse of ``torch_state_dict_to_flax``: Flax S3D variables ->
+    a flat torch-style state dict the reference's scripts can load
+    (eval_msrvtt.py:21-32 flat flavor; wrap under ``{'state_dict':
+    {'module.'+k: v}}`` for the DDP flavor).
+
+    Completes the interop loop: train here, evaluate there.  Inversion
+    is pinned by a roundtrip test (tests/test_reference_parity.py)."""
+    out: dict[str, np.ndarray] = {}
+
+    def walk(node, path, in_stats):
+        if not isinstance(node, Mapping):
+            _emit_leaf(out, path, np.asarray(node), in_stats)
+            return
+        for k, v in node.items():
+            walk(v, path + [k], in_stats)
+
+    walk(variables.get("params", {}), [], False)
+    walk(variables.get("batch_stats", {}), [], True)
+    # torch BN modules track an update count; emit one per running_mean so
+    # a strict load_state_dict finds every expected key
+    for key in [k for k in out if k.endswith("running_mean")]:
+        out[key.removesuffix("running_mean") + "num_batches_tracked"] = (
+            np.asarray(0, np.int64))
+    return out
+
+
+_INV_CONV = {"conv_spatial": "conv1", "bn_spatial": "bn1",
+             "conv_temporal": "conv2", "bn_temporal": "bn2",
+             "conv": "conv1", "bn": "bn1"}
+
+
+def _emit_leaf(out: dict, path: list[str], val: np.ndarray,
+               in_stats: bool) -> None:
+    mods = [_INV_CONV.get(m, m) for m in path[:-1]]
+    leaf = path[-1]
+    prefix = ".".join(mods)
+    if in_stats:
+        out[f"{prefix}.{ {'mean': 'running_mean', 'var': 'running_var'}[leaf] }"] = val
+    elif leaf == "scale":
+        out[f"{prefix}.weight"] = val
+    elif leaf == "bias":
+        out[f"{prefix}.bias"] = val
+    elif leaf == "embedding":
+        out[f"{prefix}.weight"] = val
+    elif leaf == "kernel":
+        if val.ndim == 5:            # flax (t,h,w,I,O) -> torch (O,I,t,h,w)
+            out[f"{prefix}.weight"] = val.transpose(4, 3, 0, 1, 2)
+        elif val.ndim == 2:          # flax (I,O) -> torch (O,I)
+            out[f"{prefix}.weight"] = val.transpose(1, 0)
+        else:
+            raise ValueError(f"unexpected kernel rank at {prefix}: {val.shape}")
+    else:
+        raise ValueError(f"unrecognized flax leaf: {'.'.join(path)}")
+
+
 def load_torch_checkpoint_as_flax(path: str) -> dict:
     """torch.load a reference checkpoint file — either flavor
     (eval_msrvtt.py:21-32): the DDP ``{'state_dict': ...}`` wrapper or the

@@ -162,3 +162,77 @@ def test_mixed5c_parity(torch_model):
                         None, mode="video", mixed5c=True)
     np.testing.assert_allclose(np.asarray(jfeat), tfeat.numpy(), atol=2e-4,
                                rtol=1e-3)
+
+
+def test_flax_to_torch_roundtrip():
+    """flax -> torch state dict -> flax must be the identity (the export
+    path the reference's eval scripts consume, utils/torch_convert.py
+    flax_to_torch_state_dict)."""
+    import jax
+    import jax.numpy as jnp
+
+    from milnce_tpu.models import S3D
+    from milnce_tpu.utils.torch_convert import (flax_to_torch_state_dict,
+                                                torch_state_dict_to_flax)
+
+    model = S3D(num_classes=16, vocab_size=32, word_embedding_dim=8,
+                text_hidden_dim=16, inception_blocks=2)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4, 32, 32, 3), jnp.float32),
+                           jnp.zeros((1, 5), jnp.int32))
+    variables = jax.device_get(
+        {"params": variables["params"], "batch_stats": variables["batch_stats"]})
+
+    sd = flax_to_torch_state_dict(variables)
+    # every tensor is torch-layout: conv weights (O,I,t,h,w)
+    assert any(k.endswith("num_batches_tracked") for k in sd)
+    back = torch_state_dict_to_flax(sd)
+
+    flat_a = jax.tree_util.tree_flatten_with_path(variables)[0]
+    flat_b = jax.tree_util.tree_flatten_with_path(back)[0]
+    assert len(flat_a) == len(flat_b)
+    keys_a = {jax.tree_util.keystr(p) for p, _ in flat_a}
+    keys_b = {jax.tree_util.keystr(p) for p, _ in flat_b}
+    assert keys_a == keys_b, keys_a ^ keys_b
+    by_key = {jax.tree_util.keystr(p): v for p, v in flat_b}
+    for path, val in flat_a:
+        np.testing.assert_array_equal(
+            val, by_key[jax.tree_util.keystr(path)], err_msg=str(path))
+
+
+def test_export_checkpoint_cli(tmp_path):
+    """Orbax run dir -> torch .pth via the assets CLI export path: the
+    file must be the DDP flavor the reference's eval format sniff
+    expects ('state_dict' + 'module.' prefixes, eval_msrvtt.py:21-26)."""
+    import jax
+    import jax.numpy as jnp
+    import torch
+
+    from milnce_tpu.config import OptimConfig
+    from milnce_tpu.models import S3D
+    from milnce_tpu.train.checkpoint import CheckpointManager
+    from milnce_tpu.train.schedule import build_schedule
+    from milnce_tpu.train.state import build_optimizer, create_train_state
+    from milnce_tpu.utils.assets import export_checkpoint
+
+    model = S3D(num_classes=16, vocab_size=32, word_embedding_dim=8,
+                text_hidden_dim=16, inception_blocks=1)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4, 32, 32, 3), jnp.float32),
+                           jnp.zeros((1, 5), jnp.int32))
+    cfg = OptimConfig(warmup_steps=1)
+    optimizer = build_optimizer(cfg, build_schedule(cfg, 4))
+    state = create_train_state(variables, optimizer)
+    run_dir = str(tmp_path / "run")
+    mgr = CheckpointManager(run_dir)
+    mgr.save(3, state)
+    mgr.wait()
+
+    dst = str(tmp_path / "export.pth")
+    assert export_checkpoint(run_dir, dst) == 3
+    raw = torch.load(dst, map_location="cpu", weights_only=False)
+    assert raw["epoch"] == 3
+    keys = list(raw["state_dict"])
+    assert keys and all(k.startswith("module.") for k in keys)
+    w = raw["state_dict"]["module.conv1.conv1.weight"]
+    assert tuple(w.shape) == (64, 3, 3, 7, 7)       # torch (O,I,t,h,w)
