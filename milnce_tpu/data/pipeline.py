@@ -62,8 +62,14 @@ class ShardedLoader:
         # would need its own XLA program per tail size).
         return len(self.source) // self.global_batch
 
-    def epoch(self, epoch: int) -> Iterator[dict]:
-        """Yield this host's batches for one epoch (dicts of stacked np)."""
+    def epoch(self, epoch: int, skip_batches: int = 0) -> Iterator[dict]:
+        """Yield this host's batches for one epoch (dicts of stacked np).
+
+        ``skip_batches`` drops the first N global batches at the INDEX
+        level — nothing is decoded for them — so a mid-epoch resume
+        (train/loop.py) continues at the exact data position: sample
+        content is a pure function of (seed, epoch, index), making the
+        epoch's order reproducible across processes and restarts."""
         import collections
 
         n = len(self.source)
@@ -74,6 +80,8 @@ class ShardedLoader:
         order = order[:usable]
         # host h takes rows h, h+pc, h+2pc... of each global batch
         local = order.reshape(-1, self.global_batch)[:, self.pi::self.pc]
+        if skip_batches:
+            local = local[skip_batches:]
         flat = local.reshape(-1)
 
         rng_base = self.seed * 100_003 + epoch

@@ -47,7 +47,12 @@ def load_variables(ckpt: str, model, model_cfg: ModelConfig,
         optimizer = build_optimizer(OptimConfig(),
                                     cosine_with_warmup(1e-3, 1, 2))
         template = create_train_state(variables, optimizer)
-        mgr = CheckpointManager(ckpt)
+        # read-only: a mistyped path must raise, not mkdir itself and
+        # silently evaluate the freshly-initialized template weights
+        mgr = CheckpointManager(ckpt, create=False)
+        if mgr.latest_epoch() is None:
+            raise FileNotFoundError(
+                f"no checkpoint saved under {ckpt!r} (empty or wrong run dir)")
         epoch, state = mgr.restore_latest(template)
         print(f"loaded Orbax checkpoint (epoch {epoch}) from {ckpt}")
         return {"params": state.params, "batch_stats": state.batch_stats}

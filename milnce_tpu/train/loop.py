@@ -131,6 +131,7 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                             cfg.train.checkpoint_dir or "run")
     manager = CheckpointManager(ckpt_dir, keep=cfg.train.checkpoint_keep)
     start_epoch = 0
+    resume_skip = 0
     if cfg.train.resume:
         start_epoch, state = manager.restore_latest(state)
         # restored arrays are committed to one device; re-replicate over the
@@ -138,7 +139,15 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         state = jax.device_put(state, NamedSharding(mesh, P()))
-        logger.log(f"resumed from epoch {start_epoch}")
+        # Mid-epoch checkpoints (preemption / max_steps) are labeled with
+        # the CURRENT epoch; the restored step counter places us inside it,
+        # and the loader skips the consumed batches at the index level so
+        # no sample is trained twice (an end-of-epoch save lands on a
+        # steps_per_epoch boundary -> skip 0).  Only valid while
+        # steps_per_epoch matches the run being resumed.
+        resume_skip = int(state.step) % steps_per_epoch
+        logger.log(f"resumed from epoch {start_epoch}"
+                   + (f" at batch {resume_skip}" if resume_skip else ""))
 
     if cfg.train.grad_accum > 1:
         from milnce_tpu.train.step import make_grad_cache_step
@@ -220,7 +229,9 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
             if (cfg.train.evaluate and cfg.data.eval_video_root
                     and epoch % eval_every == 0):
                 _in_training_eval(cfg, model, state, mesh, logger)
-            for batch in device_prefetch(loader.epoch(epoch), mesh, axis,
+            skip = resume_skip if epoch == start_epoch else 0
+            for batch in device_prefetch(loader.epoch(epoch, skip_batches=skip),
+                                         mesh, axis,
                                          depth=cfg.data.prefetch_depth):
                 video, text = flatten_text(batch)
                 start = batch.get(
@@ -256,9 +267,13 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                     if preempted["flag"]:
                         logger.log("SIGTERM — checkpointing and exiting")
                     # mid-epoch stop: label the checkpoint with the CURRENT
-                    # epoch so resume re-runs it (labelling epoch+1 would
-                    # silently skip the epoch's remaining batches)
-                    manager.save(epoch, state)
+                    # epoch so resume continues it (the restored step
+                    # counter gives the batch offset).  A stop landing on
+                    # the epoch's LAST batch must label epoch+1 — a
+                    # current-epoch label with offset 0 would retrain the
+                    # whole epoch on resume.
+                    done = int(state.step) % steps_per_epoch == 0
+                    manager.save(epoch + 1 if done else epoch, state)
                     manager.wait()
                     return TrainResult(state, total_steps,
                                        fetch(last_loss_dev))

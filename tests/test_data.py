@@ -261,3 +261,24 @@ def test_ffmpeg_decoder_gated_without_binary(monkeypatch):
     dec = FFmpegDecoder(binary="definitely-not-a-binary-xyz")
     with pytest.raises(RuntimeError, match="synthetic"):
         dec.decode("x.mp4", 0, 1.0, 2, 8)
+
+
+def test_loader_skip_batches_resumes_exact_order():
+    """epoch(skip_batches=k) must yield exactly the batches epoch() yields
+    after the first k — the mid-epoch resume contract (sample content is a
+    pure function of (seed, epoch, index), so nothing is decoded twice)."""
+    from milnce_tpu.config import DataConfig
+    from milnce_tpu.data.pipeline import ShardedLoader
+    from milnce_tpu.data.synthetic import SyntheticVideoTextSource
+
+    cfg = DataConfig(synthetic=True, synthetic_num_samples=24, num_frames=2,
+                     video_size=8, max_words=4, num_candidates=2)
+    src = SyntheticVideoTextSource(cfg, vocab_size=16)
+    loader = ShardedLoader(src, global_batch_size=4, seed=3, num_threads=2,
+                           process_index=0, process_count=1)
+    full = list(loader.epoch(epoch=1))
+    skipped = list(loader.epoch(epoch=1, skip_batches=2))
+    assert len(skipped) == len(full) - 2
+    for a, b in zip(full[2:], skipped):
+        for key in a:
+            np.testing.assert_array_equal(a[key], b[key])

@@ -406,3 +406,44 @@ class TestGradCache:
         result = run_training(cfg, max_steps=2)
         assert result.steps == 2
         assert np.isfinite(result.last_loss)
+
+
+def test_mid_epoch_resume_skips_consumed_batches(tiny_cfg, tmp_path):
+    """Preemption mid-epoch must not retrain consumed batches: a 4-step
+    epoch stopped at step 3 resumes with exactly 1 batch left."""
+    import copy
+
+    from milnce_tpu.train.loop import run_training
+
+    cfg = copy.deepcopy(tiny_cfg)
+    cfg.train.checkpoint_root = str(tmp_path / "ckpt_resume_pos")
+    cfg.data.synthetic_num_samples = 32          # 4 steps/epoch at batch 8
+    cfg.optim.epochs = 1
+    first = run_training(cfg, max_steps=3)       # mid-epoch checkpoint
+    assert first.steps == 3
+    cfg.train.resume = True
+    second = run_training(cfg)                   # finish epoch 0 only
+    assert second.steps == 1, (
+        f"resume replayed the epoch: ran {second.steps} steps, expected 1")
+    assert int(second.state.step) == 4
+
+
+def test_boundary_stop_resumes_as_epoch_complete(tiny_cfg, tmp_path):
+    """A stop landing exactly on the epoch's last batch must label the
+    checkpoint epoch+1: resuming with epochs=1 has nothing left to run
+    (a current-epoch label would retrain all 4 batches)."""
+    import copy
+
+    from milnce_tpu.train.loop import run_training
+
+    cfg = copy.deepcopy(tiny_cfg)
+    cfg.train.checkpoint_root = str(tmp_path / "ckpt_boundary")
+    cfg.data.synthetic_num_samples = 32          # 4 steps/epoch at batch 8
+    cfg.optim.epochs = 1
+    first = run_training(cfg, max_steps=4)       # stop ON the boundary
+    assert first.steps == 4
+    cfg.train.resume = True
+    second = run_training(cfg)
+    assert second.steps == 0, (
+        f"boundary stop retrained the epoch: ran {second.steps} steps")
+    assert int(second.state.step) == 4
