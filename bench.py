@@ -26,8 +26,6 @@ import subprocess
 import sys
 import time
 
-import numpy as np
-
 _REPO = os.path.dirname(os.path.abspath(__file__))
 _CHILD_MODE_ENV = "MILNCE_BENCH_CHILD_MODE"  # "cpu" | "tpu"
 
@@ -162,7 +160,7 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
 
     from milnce_tpu.config import full_preset
     from milnce_tpu.models.build import build_model
-    from milnce_tpu.parallel.mesh import build_mesh
+    from milnce_tpu.parallel.mesh import batch_sharding, build_mesh, replicated
     from milnce_tpu.train.schedule import build_schedule
     from milnce_tpu.train.state import build_optimizer, create_train_state
     from milnce_tpu.train.step import make_train_step
@@ -175,21 +173,39 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
     model = build_model(cfg.model)
     mesh = build_mesh(cfg.parallel)
 
-    rng = np.random.RandomState(0)
-    video = rng.randint(0, 255, (batch, frames, size, size, 3), np.uint8)
-    text = rng.randint(0, cfg.model.vocab_size, (batch * k, words)).astype(np.int32)
-
-    variables = model.init(jax.random.PRNGKey(0),
-                           jnp.zeros((2, frames, size, size, 3), jnp.float32),
-                           jnp.zeros((2 * k, words), jnp.int32))
     optimizer = build_optimizer(cfg.optim, build_schedule(cfg.optim, 1000))
-    state = create_train_state(variables, optimizer)
     step_fn = make_train_step(model, optimizer, mesh, donate=False,
                               inner_steps=inner)
 
-    video_d = jax.device_put(video)
-    text_d = jax.device_put(text)
-    start_d = jax.device_put(np.zeros((batch,), np.float32))
+    # Everything below runs ON DEVICE in three jitted programs.  The
+    # obvious host-side version (eager model.init + optimizer.init +
+    # device_put of host-generated arrays) issues hundreds of tiny
+    # dispatches and ships ~0.1-1 GB of synthetic video over the wire —
+    # over the remote TPU tunnel (multi-second per-dispatch latency,
+    # limited bandwidth) that took LONGER than the measurement itself.
+    repl = replicated(mesh)
+    data_sh = batch_sharding(mesh, cfg.parallel.data_axis)
+
+    def init_state(key):
+        variables = model.init(
+            key, jnp.zeros((2, frames, size, size, 3), jnp.float32),
+            jnp.zeros((2 * k, words), jnp.int32))
+        return create_train_state(variables, optimizer)
+
+    state = jax.jit(init_state, out_shardings=repl)(jax.random.PRNGKey(0))
+
+    def make_inputs(key):
+        kv, kt = jax.random.split(key)
+        video = jax.random.randint(
+            kv, (batch, frames, size, size, 3), 0, 255).astype(jnp.uint8)
+        text = jax.random.randint(
+            kt, (batch * k, words), 0, cfg.model.vocab_size, jnp.int32)
+        start = jnp.zeros((batch,), jnp.float32)
+        return video, text, start
+
+    video_d, text_d, start_d = jax.jit(
+        make_inputs, out_shardings=(data_sh, data_sh, data_sh))(
+            jax.random.PRNGKey(1))
 
     if flops_hint is not None:
         # Seeded from an earlier XLA-counted config of the same plan (see

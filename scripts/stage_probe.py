@@ -91,7 +91,6 @@ def main() -> None:
 
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
     jax.config.update("jax_compilation_cache_dir",
                       os.path.join(_REPO, "build", "jax_cache"))
@@ -106,10 +105,13 @@ def main() -> None:
     cfg.model.dtype = args.dtype
     cfg.model.conv_impl = args.conv_impl
     model = build_model(cfg.model)
-    variables = model.init(
-        jax.random.PRNGKey(0),
-        jnp.zeros((2, args.frames, args.size, args.size, 3), jnp.float32),
-        jnp.zeros((2, 6), jnp.int32))
+    # jit the init: eager Flax init dispatches every parameter's RNG +
+    # op individually — multi-second per-dispatch latency over the axon
+    # tunnel turns that into tens of minutes (bench.py learned the same)
+    variables = jax.jit(lambda key: model.init(
+        key, jnp.zeros((2, args.frames, args.size, args.size, 3),
+                       jnp.float32),
+        jnp.zeros((2, 6), jnp.int32)))(jax.random.PRNGKey(0))
 
     dev_kind = getattr(jax.devices()[0], "device_kind",
                        jax.devices()[0].platform)
@@ -163,10 +165,18 @@ def main() -> None:
         flops_by_prefix[prefix] = flops_by_prefix.get(prefix, 0.0) + st.flops
         bytes_by_prefix[prefix] = bytes_by_prefix.get(prefix, 0.0) + st.bytes
 
-    rng = np.random.RandomState(0)
-    x = jnp.asarray(
-        rng.rand(args.batch, args.frames, args.size, args.size, 3)
-        .astype(np.float32)).astype(compute_dtype)
+    # synthetic input generated ON DEVICE: shipping host-generated video
+    # over the tunnel costs more than the measurement.  One jitted
+    # generator reused for both seeds (a fresh lambda per call would
+    # miss the jit trace cache and recompile over the tunnel).
+    _gen_input = jax.jit(lambda key: jax.random.uniform(
+        key, (args.batch, args.frames, args.size, args.size, 3),
+        jnp.float32).astype(compute_dtype))
+
+    def device_input(seed):
+        return _gen_input(jax.random.PRNGKey(seed))
+
+    x = device_input(0)
 
     records = []
     total_ms = 0.0
@@ -196,9 +206,7 @@ def main() -> None:
     # whole-trunk forward for reconciliation (sum of parts vs one program:
     # the difference is what XLA's cross-stage fusion buys)
     trunk = stage_apply(lambda m, v: m.forward_video(v))
-    x0 = jnp.asarray(
-        rng.rand(args.batch, args.frames, args.size, args.size, 3)
-        .astype(np.float32)).astype(compute_dtype)
+    x0 = device_input(1)
     t_trunk = _timed(trunk, x0, args.iters)
     summary = {
         "stage": "TRUNK_FWD(one program)",
