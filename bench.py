@@ -1,14 +1,16 @@
 """Throughput benchmark: clips/sec/chip of the full jitted train step
 (S3D-G fwd+bwd + MIL-NCE + Adam) on synthetic data.
 
-Prints exactly ONE JSON line on stdout:
+Streams one-line JSON records to stdout:
     {"metric", "value", "unit", "vs_baseline", ...}
-and NEVER exits without printing it — backend init is guarded (retry,
-then CPU-fallback re-exec, then a parsable error record).  Measurement
-children additionally stream an interim best-so-far record after every
-config, so a tunnel hang mid-sweep still surfaces the rows already
-measured (the parent forwards the last parsable line).  Detailed sweep
-results (per-dtype, per-batch, MFU) go to stderr and ``BENCH_NOTES.md``.
+**Consumers take the LAST parsable record line** — an interim
+best-so-far is emitted after every measured config (forwarded upward by
+the parent as it arrives), superseded by the final record, so ANY exit
+— crash, tunnel hang, even a hard kill of the parent mid-sweep — leaves
+the best measurement so far on stdout.  Backend init is guarded (probe,
+CPU-fallback re-exec, then a parsable error record): the process never
+exits without at least one record line.  Detailed sweep results
+(per-dtype, per-batch, MFU) go to stderr and ``BENCH_NOTES.md``.
 
 The reference publishes no throughput numbers (BASELINE.md: "to be
 established"); the headline metric is the best clips/sec/chip across the
@@ -24,10 +26,13 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
 _CHILD_MODE_ENV = "MILNCE_BENCH_CHILD_MODE"  # "cpu" | "tpu"
+_CONFIG_ENV = "MILNCE_BENCH_CONFIG_JSON"     # one-config measurement child
+_INFO_ENV = "MILNCE_BENCH_DEVICE_INFO"       # probe's device info, reused
 
 # clips/sec/chip anchor for vs_baseline: the first recorded real-TPU
 # operating point (round-2 session, v5e, bfloat16 batch 256 @16f/224 —
@@ -54,9 +59,10 @@ def _emit(result):
     sys.stdout.flush()
 
 
-def _last_json(raw: bytes):
-    """The last parsable bench record in a child's captured stdout (the
-    interim-streaming protocol: later records supersede earlier ones)."""
+def _last_tagged_json(raw: bytes, predicate):
+    """The last JSON object in ``raw`` whose dict satisfies ``predicate``
+    (the streaming protocols all agree: later lines supersede earlier
+    ones; stray JSON-shaped log lines are filtered by the predicate)."""
     for line in reversed(raw.decode(errors="replace").splitlines()):
         line = line.strip()
         if line.startswith("{"):
@@ -64,10 +70,15 @@ def _last_json(raw: bytes):
                 rec = json.loads(line)
             except Exception:
                 continue
-            # only the bench record, not stray JSON-shaped log lines
-            if isinstance(rec, dict) and "metric" in rec and "value" in rec:
+            if isinstance(rec, dict) and predicate(rec):
                 return rec
     return None
+
+
+def _last_json(raw: bytes):
+    """The last parsable bench record in a child's captured stdout (the
+    interim-streaming protocol: later records supersede earlier ones)."""
+    return _last_tagged_json(raw, lambda r: "metric" in r and "value" in r)
 
 
 def _note(msg):
@@ -83,7 +94,7 @@ def _peak_flops(device_kind: str):
     return None
 
 
-def _probe_backend(timeout_s: float = 180.0) -> bool:
+def _probe_backend(timeout_s: float = 180.0):
     """Initialize the accelerator backend AND run one tiny jitted execute
     in a THROWAWAY subprocess first.
 
@@ -95,33 +106,68 @@ def _probe_backend(timeout_s: float = 180.0) -> bool:
     compile-helper ports refuse connections).  A hang in the main
     process would eat the driver's whole gate timeout with no JSON
     emitted; probing with a real execute converts all three into a
-    clean boolean."""
-    code = ("import jax, jax.numpy as jnp; "
-            "print(float(jax.jit(lambda: jnp.ones(4).sum())()))")
+    clean verdict.
+
+    Returns the device-info dict (platform/kind/n) on success — the
+    probe already paid for a live backend, so it reports what it sees
+    and spares the sweep a second multi-minute tunnel bring-up — or
+    None on any failure."""
+    code = ("import json, jax, jax.numpy as jnp; "
+            "v = float(jax.jit(lambda: jnp.ones(4).sum())()); "
+            "d = jax.devices(); "
+            "print(json.dumps({'platform': d[0].platform, "
+            "'kind': str(getattr(d[0], 'device_kind', d[0].platform)), "
+            "'n': len(d), 'probe_value': v}))")
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
     try:
-        proc = subprocess.run([sys.executable, "-c", code],
-                              capture_output=True, timeout=timeout_s)
-        ok = proc.returncode == 0
-        if not ok:
-            _note(f"bench: backend probe rc={proc.returncode}: "
-                  f"{proc.stderr.decode()[-300:]}")
-        return ok
+        out, err = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
+        _graceful_stop(proc)
         _note(f"bench: backend probe hung >{timeout_s}s — falling back")
-        return False
+        return None
+    if proc.returncode != 0:
+        _note(f"bench: backend probe rc={proc.returncode}: "
+              f"{err.decode(errors='replace')[-300:]}")
+        return None
+    info = _last_tagged_json(out, lambda r: "platform" in r)
+    if info is None:
+        _note("bench: backend probe printed no device info — falling back")
+    return info
 
 
-def _devices():
-    """jax.devices(), or raise. No in-process retry: jax caches a failed
-    backend init, so a second call in this process can only re-raise —
-    recovery happens in main()'s fresh-subprocess CPU fallback."""
-    import jax
+def _device_info(timeout_s: float = 240.0, force_cpu: bool = False) -> dict:
+    """Platform / device-kind / chip-count, read in a THROWAWAY
+    subprocess.  The sweep orchestrator must never hold a live TPU
+    client itself: its per-config measurement children each open their
+    own connection, and a second concurrent client is a tunnel failure
+    mode we can't afford in a gate.
 
+    ``force_cpu`` pins via jax.config INSIDE the subprocess — the
+    JAX_PLATFORMS env var is overridden by accelerator plugins that
+    force their own platform list (so a "CPU" probe would otherwise
+    still try to init the TPU tunnel and can hang there)."""
+    pin = ("jax.config.update('jax_platforms', 'cpu'); "
+           if force_cpu else "")
+    code = ("import json, jax; " + pin + "d = jax.devices(); "
+            "print(json.dumps({'platform': d[0].platform, "
+            "'kind': str(getattr(d[0], 'device_kind', d[0].platform)), "
+            "'n': len(d)}))")
+    proc = subprocess.Popen([sys.executable, "-c", code], cwd=_REPO,
+                            env=dict(os.environ), stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE)
     try:
-        return jax.devices()
-    except Exception as exc:  # backend init failure (round-1 failure mode)
-        _note(f"bench: jax.devices() failed: {exc}")
-        raise
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        # TERM-first: a hard kill of the hung-but-live client here is
+        # what wedges the relay for every later child (_graceful_stop)
+        _graceful_stop(proc)
+        raise RuntimeError(f"device-info probe hung >{timeout_s}s")
+    info = _last_tagged_json(out, lambda r: "platform" in r)
+    if info is not None:
+        return info
+    raise RuntimeError(f"device-info probe rc={proc.returncode}: "
+                       f"{err.decode(errors='replace')[-300:]}")
 
 
 def _step_flops(step_fn, args):
@@ -287,7 +333,7 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
                 f"implausible measurement: {implied:.3e} FLOP/s implied "
                 f"(dt={dt:.6f}s for {inner} steps of {flops:.3e} FLOPs "
                 f"on {n_chips} chips, bound {bound:.3e})")
-    return {
+    result = {
         "dtype": dtype,
         "batch": batch,
         "remat": remat,
@@ -300,6 +346,81 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
         "flops_source": flops_source if flops else None,
         "flops_per_sec": (flops * inner / dt) if flops else None,
     }
+    if peak and result["flops_per_sec"]:
+        result["mfu"] = round(result["flops_per_sec"] / (peak * n_chips), 4)
+    return result
+
+
+# the measurement grand-child currently running under this orchestrator
+# (None between configs) — the SIGTERM forwarder needs to reach it
+_ACTIVE_CONFIG_PROC = None
+
+
+def _forward_term_and_exit(signum, frame):
+    """Orchestrator SIGTERM handler: the parent's budget timeout TERMs
+    only this process — without forwarding, the measurement grand-child
+    (the process actually holding the live TPU tunnel client) would be
+    orphaned mid-compile, becoming both a concurrent-client hazard and a
+    future hard-kill relay wedge.  Forward the TERM, give the client the
+    same grace the parent gives us, then exit."""
+    del signum, frame
+    proc = _ACTIVE_CONFIG_PROC
+    if proc is not None and proc.poll() is None:
+        # TERM, 25s grace (inside the parent's 30s), then KILL — an
+        # orphan left alive holding the tunnel client is the one outcome
+        # strictly worse than a hard kill of a wedged one
+        _graceful_stop(proc, grace=25)
+    os._exit(1)
+
+
+def _graceful_stop(proc, grace: float = 30.0):
+    """TERM first with a grace period, then KILL.  A hard kill of a live
+    TPU client is what wedges the tunnel relay for every LATER client
+    (init succeeds, first compile hangs — observed 2026-07-30/31); a
+    SIGTERM lets the client tear its connection down cleanly.  Does not
+    read the pipe — callers own proc.stdout (possibly from a reader
+    thread)."""
+    proc.terminate()
+    try:
+        proc.wait(timeout=grace)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+
+
+def _run_config(timeout_s: float | None = None, **kwargs):
+    """Run ONE _bench_config measurement in its own subprocess.
+
+    Isolation buys two things the in-process sweep couldn't have:
+    (a) a watchdog — a wedged tunnel compile (batch-256 hung >50 min,
+    2026-07-31) costs ``timeout_s``, not the whole sweep budget; and
+    (b) a clean allocator — an OOM'd process on this backend fails even
+    tiny follow-up allocations (a batch-256 OOM killed the float32
+    batch-32 row), so every config starts in a fresh process.
+
+    Raises RuntimeError carrying the child's error text (so the caller's
+    OOM detection keeps working) or a 'config timeout' marker."""
+    global _ACTIVE_CONFIG_PROC
+    env = dict(os.environ)
+    env[_CONFIG_ENV] = json.dumps(kwargs)
+    env.pop(_CHILD_MODE_ENV, None)
+    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                            env=env, cwd=_REPO, stdout=subprocess.PIPE)
+    _ACTIVE_CONFIG_PROC = proc
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        _graceful_stop(proc)
+        raise RuntimeError(f"config timeout>{timeout_s}s: {kwargs}")
+    finally:
+        _ACTIVE_CONFIG_PROC = None
+    rec = _last_tagged_json(
+        out or b"", lambda r: "config_result" in r or "config_error" in r)
+    if rec is None:
+        raise RuntimeError(f"config child rc={proc.returncode}, no record")
+    if "config_error" in rec:
+        raise RuntimeError(rec["config_error"])
+    return rec["config_result"]
 
 
 def _is_oom(exc) -> bool:
@@ -341,14 +462,17 @@ def _make_record(best, frames, size, on_tpu, kind):
     return out
 
 
-def run_bench(on_tpu: bool):
-    import jax
-
-    devices = jax.devices()
-    kind = getattr(devices[0], "device_kind", devices[0].platform)
+def run_bench(on_tpu: bool, info: dict):
+    """Sweep orchestrator: picks configs, runs each in its own
+    watchdogged subprocess (_run_config), streams an interim best-so-far
+    record after every row.  Holds NO jax backend itself — `info` comes
+    from the _device_info probe."""
+    kind, n_devices = info["kind"], info["n"]
     peak = _peak_flops(str(kind)) if on_tpu else None
-    _note(f"bench: platform={devices[0].platform} kind={kind} "
-          f"n={len(devices)} peak_flops={peak}")
+    cfg_timeout = float(os.environ.get("MILNCE_BENCH_CONFIG_TIMEOUT",
+                                       "900" if on_tpu else "600"))
+    _note(f"bench: platform={info['platform']} kind={kind} "
+          f"n={n_devices} peak_flops={peak} config_timeout={cfg_timeout}s")
 
     # opt-in: bench the space_to_depth stem (what the original TPU
     # training used) — densifies conv1, the stage most starved on the
@@ -373,7 +497,7 @@ def run_bench(on_tpu: bool):
         inner = 1
         # batch must divide over the data mesh (a host forced to N virtual
         # CPU devices — the test rig — still has to measure something)
-        plans = [("float32", [2 * len(devices)], False)]
+        plans = [("float32", [2 * n_devices], False)]
 
     results = []
     # (dtype, remat, s2d) -> (batch, flops) seeds, XLA-sourced only (the
@@ -392,15 +516,39 @@ def run_bench(on_tpu: bool):
         linear = f0 - milnce_logits_flops(b0, k)
         return linear * batch / b0 + milnce_logits_flops(batch, k)
 
+    def measure(dtype, batch, remat, s2d, conv_impl):
+        return _run_config(
+            timeout_s=cfg_timeout, platform_pin=None if on_tpu else "cpu",
+            dtype=dtype, batch=batch, frames=frames,
+            size=size, words=words, k=k, remat=remat, inner=inner, s2d=s2d,
+            conv_impl=conv_impl, peak=peak,
+            flops_hint=hint(dtype, remat, s2d, batch))
+
+    def tunnel_wedged(exc) -> bool:
+        """A config timeout on TPU may mean the whole tunnel is wedged
+        (a dead client mid-compile hangs every later client).  Re-probe;
+        if even a trivial execute fails now, the sweep is over."""
+        if not on_tpu or "config timeout" not in str(exc):
+            return False
+        if _probe_backend():
+            return False
+        _note("bench: tunnel no longer answers after a config timeout — "
+              "ending sweep with the rows in hand")
+        return True
+
+    dead = False
     for dtype, batches, plan_remat in plans:
+        if dead:
+            break
         prev = 0.0
         remat = plan_remat
         for batch in batches:
             try:
-                r = _bench_config(dtype, batch, frames, size, words, k,
-                                  remat, inner, s2d, conv_impl, peak=peak,
-                                  flops_hint=hint(dtype, remat, s2d, batch))
+                r = measure(dtype, batch, remat, s2d, conv_impl)
             except Exception as exc:
+                if tunnel_wedged(exc):
+                    dead = True
+                    break
                 if _is_oom(exc) and not remat:
                     _note(f"bench: {dtype} batch={batch} OOM — retrying with "
                           "remat (kept on for larger batches)")
@@ -411,13 +559,9 @@ def run_bench(on_tpu: bool):
                     # before larger remat batches get their shot.
                     prev = 0.0
                     try:
-                        r = _bench_config(dtype, batch, frames, size, words,
-                                          k, remat=True, inner=inner,
-                                          s2d=s2d, conv_impl=conv_impl,
-                                          peak=peak,
-                                          flops_hint=hint(dtype, True, s2d,
-                                                          batch))
+                        r = measure(dtype, batch, True, s2d, conv_impl)
                     except Exception as exc2:
+                        dead = tunnel_wedged(exc2)
                         _note(f"bench: {dtype} batch={batch} remat also failed: "
                               f"{type(exc2).__name__} — stopping sweep")
                         break
@@ -430,8 +574,6 @@ def run_bench(on_tpu: bool):
             if r["flops_per_step"] and r.get("flops_source") == "xla":
                 flops_seen.setdefault((dtype, remat, s2d),
                                       (batch, r["flops_per_step"]))
-            if peak and r["flops_per_sec"]:
-                r["mfu"] = round(r["flops_per_sec"] / (peak * len(devices)), 4)
             _note(f"bench: {r}")
             results.append(r)
             # Interim record after every config: a later config hanging
@@ -454,21 +596,21 @@ def run_bench(on_tpu: bool):
     def extra_row(label, **overrides):
         """One comparison row at the winning operating point, with the
         same record/interim-emit protocol as the sweep rows."""
-        nonlocal best
+        nonlocal best, dead
+        if dead:
+            return
         try:
-            kw = dict(remat=best["remat"], inner=inner,
-                      s2d=best.get("s2d", False), conv_impl=conv_impl,
-                      peak=peak)
+            kw = dict(dtype=best["dtype"], batch=best["batch"],
+                      remat=best["remat"], s2d=best.get("s2d", False),
+                      conv_impl=conv_impl)
             kw.update(overrides)
-            r = _bench_config(best["dtype"], best["batch"], frames, size,
-                              words, k, **kw)
-            if peak and r["flops_per_sec"]:
-                r["mfu"] = round(r["flops_per_sec"] / (peak * len(devices)), 4)
+            r = measure(**kw)
             _note(f"bench: {r}")
             results.append(r)
             best = max(results, key=lambda x: x["clips_per_sec_per_chip"])
             _emit(_make_record(best, frames, size, on_tpu, kind))
         except Exception as exc:
+            dead = tunnel_wedged(exc)
             _note(f"bench: {label} row failed ({type(exc).__name__}: {exc})"
                   " — keeping prior results")
 
@@ -485,11 +627,18 @@ def run_bench(on_tpu: bool):
             and os.environ.get("MILNCE_BENCH_FOLD2D") != "0"):
         extra_row("fold2d", conv_impl="fold2d")
 
-    _write_notes(results, best, kind, on_tpu, len(devices))
-    return _make_record(best, frames, size, on_tpu, kind)
+    _write_notes(results, best, kind, on_tpu, n_devices,
+                 truncated=dead)
+    final = _make_record(best, frames, size, on_tpu, kind)
+    if dead:
+        # machine-visible truncation: rows measured before the tunnel
+        # died must not read as a complete sweep (the orchestrator still
+        # exits 0, so the parent's timeout marker never fires)
+        final["partial"] = "tunnel wedged mid-sweep"
+    return final
 
 
-def _write_notes(results, best, kind, on_tpu, n_chips):
+def _write_notes(results, best, kind, on_tpu, n_chips, truncated=False):
     notes = os.path.join(_REPO, "BENCH_NOTES.md")
     if not on_tpu and os.path.exists(notes):
         # never clobber a real-TPU sweep with CPU-fallback numbers
@@ -511,6 +660,10 @@ def _write_notes(results, best, kind, on_tpu, n_chips):
                          f"{r.get('conv_impl', 'native')} | "
                          f"{r['step_ms']} | {r['clips_per_sec_per_chip']} | "
                          f"{r.get('mfu', '-')} |")
+        if truncated:
+            lines += ["", "**SWEEP TRUNCATED**: the TPU tunnel wedged "
+                      "mid-sweep; rows above are what was measured "
+                      "before it died."]
         lines += ["", "Roofline context for these numbers: PERF.md "
                   "(analytic per-stage FLOPs/bytes/intensity model)."]
         with open(os.path.join(_REPO, "BENCH_NOTES.md"), "w") as fh:
@@ -530,22 +683,50 @@ def main():
         except Exception:
             pass
 
+        cfg_json = os.environ.get(_CONFIG_ENV)
+        if cfg_json:
+            # Measurement grand-child: time exactly ONE config in this
+            # fresh process (clean allocator, own tunnel client) and hand
+            # the result dict up as a tagged JSON line.  Errors are data
+            # too — the orchestrator's OOM/timeout handling needs the
+            # text — so they go to stdout tagged, never the driver record.
+            try:
+                kwargs = json.loads(cfg_json)
+                # env-var platform pins are overridden by accelerator
+                # plugins; the jax.config route wins (conftest.py note)
+                if kwargs.pop("platform_pin", None) == "cpu":
+                    jax.config.update("jax_platforms", "cpu")
+                r = _bench_config(**kwargs)
+                _emit({"config_result": r})
+                return
+            except Exception as exc:
+                _emit({"config_error": f"{type(exc).__name__}: {exc}"})
+                sys.exit(1)
+
         mode = os.environ.get(_CHILD_MODE_ENV)
         if mode in ("cpu", "tpu"):
-            # Child: measure and print records to stdout (captured by the
-            # parent, which is the single emitter).  run_bench streams an
-            # interim best-so-far record after each config, so a child
-            # that dies mid-sweep leaves its completed rows behind; a
-            # child that fails before ANY config exits nonzero with no
-            # record and the parent falls back — a swallowed 0.0 record
-            # here would mask a working CPU path.
+            # Sweep-orchestrator child: picks configs, spawns one
+            # measurement grand-child per config, prints interim records
+            # to stdout (streamed upward by the parent).  It never holds
+            # a backend itself — a second concurrent tunnel client is a
+            # failure mode.  A child that fails before ANY config exits
+            # nonzero with no record and the parent falls back — a
+            # swallowed 0.0 record here would mask a working CPU path.
             try:
-                if mode == "cpu":
-                    jax.config.update("jax_platforms", "cpu")
-                devices = _devices()
+                import signal
+
+                signal.signal(signal.SIGTERM, _forward_term_and_exit)
+                info_env = os.environ.get(_INFO_ENV)
+                if info_env and mode == "tpu":
+                    # the parent's probe already initialized a backend
+                    # and reported what it saw — don't pay the tunnel
+                    # bring-up a second time
+                    info = json.loads(info_env)
+                else:
+                    info = _device_info(force_cpu=(mode == "cpu"))
                 on_tpu = (mode == "tpu" and
-                          any(d.platform in ("tpu", "axon") for d in devices))
-                _emit(run_bench(on_tpu))
+                          info["platform"] in ("tpu", "axon"))
+                _emit(run_bench(on_tpu, info))
                 return
             except Exception as exc:
                 _note(f"bench child[{mode}]: {type(exc).__name__}: {exc}")
@@ -554,38 +735,49 @@ def main():
         # Parent: orchestrate the measurement in CHILDREN so no tunnel
         # failure mode — crash, hang at init, or hang at first execute
         # (all three observed) — can eat the driver's gate timeout
-        # without a JSON line being printed.  Child stdout is captured
-        # and the LAST parsable JSON line forwarded (_last_json), so
-        # exactly one record ever reaches the driver.
-        def run_child(child_mode: str, timeout=None):
+        # without a JSON line being printed.  Child records are STREAMED
+        # to our stdout as they arrive (later records supersede earlier:
+        # the consumer takes the last parsable line), so even a hard
+        # kill of this parent mid-sweep leaves the best-so-far behind.
+        def run_child(child_mode: str, timeout=None, device_info=None):
             env = dict(os.environ)
             env[_CHILD_MODE_ENV] = child_mode
-            if child_mode == "cpu":
-                env["JAX_PLATFORMS"] = "cpu"
+            if device_info:
+                env[_INFO_ENV] = json.dumps(device_info)
             proc = subprocess.Popen(
                 [sys.executable, os.path.abspath(__file__)],
                 env=env, cwd=_REPO, stdout=subprocess.PIPE)
+            last = None
+
+            def pump():
+                nonlocal last
+                for raw in proc.stdout:
+                    rec = _last_json(raw)
+                    if rec is not None:
+                        last = rec
+                        _emit(rec)
+
+            reader = threading.Thread(target=pump, daemon=True)
+            reader.start()
             try:
-                out, _ = proc.communicate(timeout=timeout)
+                proc.wait(timeout=timeout)
                 status = "ok" if proc.returncode == 0 else f"rc={proc.returncode}"
             except subprocess.TimeoutExpired:
                 # SIGTERM first with a grace period: a hard kill of a live
                 # TPU client is what wedges the relay (SKILL.md notes);
                 # only escalate if the client ignores the term.
-                proc.terminate()
-                try:
-                    out, _ = proc.communicate(timeout=30)
-                except subprocess.TimeoutExpired:
-                    proc.kill()
-                    out, _ = proc.communicate()
+                _graceful_stop(proc)
                 status = f"timeout>{timeout}s"
-            return _last_json(out or b""), status
+            reader.join(timeout=10)
+            return last, status
 
-        if _probe_backend():
+        probe_info = _probe_backend()
+        if probe_info:
             # Even a healthy-probing tunnel can wedge mid-sweep; bound the
             # whole TPU run and fall back rather than hang the gate.
             budget = float(os.environ.get("MILNCE_BENCH_TPU_TIMEOUT", "2400"))
-            rec, status = run_child("tpu", timeout=budget)
+            rec, status = run_child("tpu", timeout=budget,
+                                    device_info=probe_info)
             if rec is not None:
                 if status != "ok":
                     _note(f"bench: TPU child {status}; forwarding the record "

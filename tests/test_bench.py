@@ -72,6 +72,39 @@ def test_peak_flops_lookup():
 
 
 @pytest.mark.slow
+class TestConfigChild:
+    """The per-config measurement grand-child protocol: one tagged JSON
+    line per run, errors carried as data (the orchestrator's OOM /
+    timeout handling matches on the text).  Each test spawns a fresh
+    python that imports jax — slow-marked like the end-to-end child."""
+
+    def test_device_info_cpu(self):
+        info = bench._device_info(force_cpu=True)
+        assert info["platform"] == "cpu" and info["n"] >= 1
+
+    def test_run_config_error_text_propagates(self):
+        # an impossible config must raise with the child's error text,
+        # not hang or return a record
+        with pytest.raises(RuntimeError) as exc_info:
+            bench._run_config(timeout_s=300, platform_pin="cpu",
+                              dtype="no_such_dtype", batch=1, frames=2,
+                              size=8, words=4, k=2, remat=False, inner=1,
+                              s2d=False, conv_impl="native", peak=None,
+                              flops_hint=1.0)
+        assert "no_such_dtype" in str(exc_info.value) or "TypeError" in str(
+            exc_info.value) or "dtype" in str(exc_info.value)
+
+    def test_run_config_timeout_is_tagged(self):
+        # a child that cannot finish inside the watchdog raises the
+        # 'config timeout' marker the sweep's wedge detection keys on
+        with pytest.raises(RuntimeError, match="config timeout"):
+            bench._run_config(timeout_s=0.5, platform_pin="cpu",
+                              dtype="float32", batch=1, frames=2, size=8,
+                              words=4, k=2, remat=False, inner=1, s2d=False,
+                              conv_impl="native", peak=None, flops_hint=1.0)
+
+
+@pytest.mark.slow
 def test_cpu_child_end_to_end():
     """The CPU measurement child — the gate's last line of defense before
     the error record — must emit at least one parsable record with a
