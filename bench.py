@@ -38,8 +38,15 @@ _INFO_ENV = "MILNCE_BENCH_DEVICE_INFO"       # probe's device info, reused
 # operating point (round-2 session, v5e, bfloat16 batch 256 @16f/224 —
 # BENCH_NOTES.md).  Later rounds report speedup against it.  Only
 # meaningful for on-TPU runs; CPU fallbacks report vs_baseline for
-# completeness but are not comparable.
+# completeness but are not comparable.  NOTE: recorded with
+# latency-inclusive timing (the record's anchor_timing field says so);
+# the best measurement under the current differenced+materialized
+# method is LAST_TPU_OPERATING_POINT.
 BASELINE_THROUGHPUT = 95.35
+
+# best honest (differenced + host-materialized) real-TPU measurement so
+# far — what a CPU-fallback record should point readers at
+LAST_TPU_OPERATING_POINT = 392.95
 
 # Peak dense matmul FLOP/s per chip (bf16), by device_kind substring.
 # Public figures; used only for the MFU diagnostic.
@@ -94,6 +101,44 @@ def _peak_flops(device_kind: str):
     return None
 
 
+def _probe_device_json(run_execute: bool, force_cpu: bool, timeout_s: float):
+    """Shared device-probe subprocess: spawn a throwaway python, optionally
+    pin it to CPU (via jax.config — the JAX_PLATFORMS env var is
+    overridden by accelerator plugins), optionally run one tiny jitted
+    execute, and print the device-info JSON.  TERM-first on timeout
+    (_graceful_stop) and registered as the active child so the SIGTERM
+    forwarder reaches a probe that happens to be live when the parent's
+    budget expires.  Returns (info_dict_or_None, error_text_or_None)."""
+    global _ACTIVE_CHILD_PROC
+    pin = ("jax.config.update('jax_platforms', 'cpu'); "
+           if force_cpu else "")
+    execute = ("v = float(jax.jit(lambda: jnp.ones(4).sum())()); "
+               if run_execute else "v = None; ")
+    code = ("import json, jax, jax.numpy as jnp; " + pin + execute +
+            "d = jax.devices(); "
+            "print(json.dumps({'platform': d[0].platform, "
+            "'kind': str(getattr(d[0], 'device_kind', d[0].platform)), "
+            "'n': len(d), 'probe_value': v}))")
+    proc = subprocess.Popen([sys.executable, "-c", code], cwd=_REPO,
+                            env=dict(os.environ), stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE)
+    _ACTIVE_CHILD_PROC = proc
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        _graceful_stop(proc)
+        return None, f"hung >{timeout_s}s"
+    finally:
+        _ACTIVE_CHILD_PROC = None
+    if proc.returncode != 0:
+        return None, (f"rc={proc.returncode}: "
+                      f"{err.decode(errors='replace')[-300:]}")
+    info = _last_tagged_json(out, lambda r: "platform" in r)
+    if info is None:
+        return None, "printed no device info"
+    return info, None
+
+
 def _probe_backend(timeout_s: float = 180.0):
     """Initialize the accelerator backend AND run one tiny jitted execute
     in a THROWAWAY subprocess first.
@@ -112,62 +157,24 @@ def _probe_backend(timeout_s: float = 180.0):
     probe already paid for a live backend, so it reports what it sees
     and spares the sweep a second multi-minute tunnel bring-up — or
     None on any failure."""
-    code = ("import json, jax, jax.numpy as jnp; "
-            "v = float(jax.jit(lambda: jnp.ones(4).sum())()); "
-            "d = jax.devices(); "
-            "print(json.dumps({'platform': d[0].platform, "
-            "'kind': str(getattr(d[0], 'device_kind', d[0].platform)), "
-            "'n': len(d), 'probe_value': v}))")
-    proc = subprocess.Popen([sys.executable, "-c", code],
-                            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
-    try:
-        out, err = proc.communicate(timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        _graceful_stop(proc)
-        _note(f"bench: backend probe hung >{timeout_s}s — falling back")
-        return None
-    if proc.returncode != 0:
-        _note(f"bench: backend probe rc={proc.returncode}: "
-              f"{err.decode(errors='replace')[-300:]}")
-        return None
-    info = _last_tagged_json(out, lambda r: "platform" in r)
-    if info is None:
-        _note("bench: backend probe printed no device info — falling back")
+    info, err = _probe_device_json(run_execute=True, force_cpu=False,
+                                   timeout_s=timeout_s)
+    if err:
+        _note(f"bench: backend probe {err} — falling back")
     return info
 
 
 def _device_info(timeout_s: float = 240.0, force_cpu: bool = False) -> dict:
     """Platform / device-kind / chip-count, read in a THROWAWAY
-    subprocess.  The sweep orchestrator must never hold a live TPU
-    client itself: its per-config measurement children each open their
-    own connection, and a second concurrent client is a tunnel failure
-    mode we can't afford in a gate.
-
-    ``force_cpu`` pins via jax.config INSIDE the subprocess — the
-    JAX_PLATFORMS env var is overridden by accelerator plugins that
-    force their own platform list (so a "CPU" probe would otherwise
-    still try to init the TPU tunnel and can hang there)."""
-    pin = ("jax.config.update('jax_platforms', 'cpu'); "
-           if force_cpu else "")
-    code = ("import json, jax; " + pin + "d = jax.devices(); "
-            "print(json.dumps({'platform': d[0].platform, "
-            "'kind': str(getattr(d[0], 'device_kind', d[0].platform)), "
-            "'n': len(d)}))")
-    proc = subprocess.Popen([sys.executable, "-c", code], cwd=_REPO,
-                            env=dict(os.environ), stdout=subprocess.PIPE,
-                            stderr=subprocess.PIPE)
-    try:
-        out, err = proc.communicate(timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        # TERM-first: a hard kill of the hung-but-live client here is
-        # what wedges the relay for every later child (_graceful_stop)
-        _graceful_stop(proc)
-        raise RuntimeError(f"device-info probe hung >{timeout_s}s")
-    info = _last_tagged_json(out, lambda r: "platform" in r)
-    if info is not None:
-        return info
-    raise RuntimeError(f"device-info probe rc={proc.returncode}: "
-                       f"{err.decode(errors='replace')[-300:]}")
+    subprocess (no execute — topology only).  The sweep orchestrator
+    must never hold a live TPU client itself: its per-config measurement
+    children each open their own connection, and a second concurrent
+    client is a tunnel failure mode we can't afford in a gate."""
+    info, err = _probe_device_json(run_execute=False, force_cpu=force_cpu,
+                                   timeout_s=timeout_s)
+    if info is None:
+        raise RuntimeError(f"device-info probe {err}")
+    return info
 
 
 def _step_flops(step_fn, args):
@@ -353,7 +360,7 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
 
 # the measurement grand-child currently running under this orchestrator
 # (None between configs) — the SIGTERM forwarder needs to reach it
-_ACTIVE_CONFIG_PROC = None
+_ACTIVE_CHILD_PROC = None
 
 
 def _forward_term_and_exit(signum, frame):
@@ -364,7 +371,7 @@ def _forward_term_and_exit(signum, frame):
     future hard-kill relay wedge.  Forward the TERM, give the client the
     same grace the parent gives us, then exit."""
     del signum, frame
-    proc = _ACTIVE_CONFIG_PROC
+    proc = _ACTIVE_CHILD_PROC
     if proc is not None and proc.poll() is None:
         # TERM, 25s grace (inside the parent's 30s), then KILL — an
         # orphan left alive holding the tunnel client is the one outcome
@@ -400,20 +407,20 @@ def _run_config(timeout_s: float | None = None, **kwargs):
 
     Raises RuntimeError carrying the child's error text (so the caller's
     OOM detection keeps working) or a 'config timeout' marker."""
-    global _ACTIVE_CONFIG_PROC
+    global _ACTIVE_CHILD_PROC
     env = dict(os.environ)
     env[_CONFIG_ENV] = json.dumps(kwargs)
     env.pop(_CHILD_MODE_ENV, None)
     proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
                             env=env, cwd=_REPO, stdout=subprocess.PIPE)
-    _ACTIVE_CONFIG_PROC = proc
+    _ACTIVE_CHILD_PROC = proc
     try:
         out, _ = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
         _graceful_stop(proc)
         raise RuntimeError(f"config timeout>{timeout_s}s: {kwargs}")
     finally:
-        _ACTIVE_CONFIG_PROC = None
+        _ACTIVE_CHILD_PROC = None
     rec = _last_tagged_json(
         out or b"", lambda r: "config_result" in r or "config_error" in r)
     if rec is None:
@@ -455,10 +462,12 @@ def _make_record(best, frames, size, on_tpu, kind):
         out["mfu"] = best["mfu"]
     if not on_tpu:
         # a fallback record must point at the real data: the recorded TPU
-        # operating point lives in BENCH_NOTES.md and anchors vs_baseline
+        # operating point lives in BENCH_NOTES.md
         out["note"] = ("accelerator unavailable — CPU fallback; last "
-                       f"recorded TPU operating point {BASELINE_THROUGHPUT} "
-                       "clips/sec/chip (BENCH_NOTES.md)")
+                       "recorded TPU operating point "
+                       f"{LAST_TPU_OPERATING_POINT} clips/sec/chip "
+                       "(BENCH_NOTES.md)")
+        out["last_tpu_value"] = LAST_TPU_OPERATING_POINT
     return out
 
 

@@ -200,6 +200,11 @@ def main() -> None:
         }
         print(json.dumps(rec), flush=True)
         records.append(rec)
+        if on_tpu:
+            # rewrite after EVERY stage: a tunnel wedge mid-probe (the
+            # observed killed-client failure mode) must not cost the
+            # stages already measured
+            _write_md(records, args)
         total_ms += t * 1e3
         x = jax.jit(fn)(x)              # advance to the next stage's input
 
