@@ -152,14 +152,9 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
     if cfg.train.grad_accum > 1:
         from milnce_tpu.train.step import make_grad_cache_step
 
-        if cfg.loss.name != "milnce":
-            raise ValueError(
-                f"train.grad_accum > 1 requires loss.name='milnce' (got "
-                f"{cfg.loss.name!r}): the two-pass embedding-cache step is "
-                "defined for the MIL-NCE loss; the DTW family gathers "
-                "sequence embeddings — run it un-accumulated")
         step_fn = make_grad_cache_step(model, optimizer, mesh,
-                                       cfg.train.grad_accum, data_axis=axis)
+                                       cfg.train.grad_accum, data_axis=axis,
+                                       loss_cfg=cfg.loss)
     else:
         step_fn = make_train_step(model, optimizer, mesh, data_axis=axis,
                                   loss_cfg=cfg.loss)

@@ -68,8 +68,9 @@ def _sequence_loss(loss_cfg, v_seq, t_seq, start, data_axis):
 
 def make_grad_cache_step(model, optimizer, mesh: Mesh,
                          micro_batches: int, data_axis: str = "data",
-                         donate: bool = True):
-    """Two-pass embedding-cache MIL-NCE train step (GradCache-style).
+                         donate: bool = True, loss_cfg=None):
+    """Two-pass embedding-cache train step (GradCache-style) for every
+    batch-contrastive loss: MIL-NCE and the DTW family.
 
     Contrastive losses don't decompose across plain gradient-accumulation
     microbatches — every clip must score against EVERY other clip in the
@@ -79,24 +80,32 @@ def make_grad_cache_step(model, optimizer, mesh: Mesh,
 
     1. embed all M microbatches under ``lax.scan`` (activations for one
        microbatch live at a time);
-    2. compute the mesh-global MIL-NCE loss and its gradient w.r.t. the
-       CACHED embeddings — cheap, embeddings are (B, D);
+    2. compute the mesh-global loss and its gradient w.r.t. the CACHED
+       embeddings — cheap: pooled (B, D) for MIL-NCE, sequence
+       (B, T', D) for the DTW family (T' = temporal extent after the
+       trunk, 8 frames -> 4);
     3. re-forward each microbatch seeding its VJP with the cached
        embedding gradients, accumulating parameter gradients.
 
     Cost: one extra forward (the pass-2 re-forward) — the same trade
     ``remat`` makes, but at 1/M activation memory with exact full-batch
-    negatives.  Each microbatch computes its own BatchNorm statistics, so
-    a microbatch behaves exactly like an extra data-parallel shard with
-    local BN (the reference's semantics, README.md:13):
-    ``M microbatches x N chips == 1 microbatch x M*N chips`` to float
-    tolerance (pinned in tests/test_train.py).
+    negatives/alignment pairs.  Each microbatch computes its own
+    BatchNorm statistics, so a microbatch behaves exactly like an extra
+    data-parallel shard with local BN (the reference's semantics,
+    README.md:13): ``M microbatches x N chips == 1 microbatch x M*N
+    chips`` to float tolerance (pinned in tests/test_train.py for both
+    loss families).
+
+    Gradient reduction follows make_train_step: ``psum`` for MIL-NCE
+    (per-shard partial sums), ``pmean`` for the DTW family (the gathered
+    loss is replicated on every shard, so the all_gather transpose
+    already accumulates a mesh-size factor into the embedding grads).
     """
     assert micro_batches > 1, "use make_train_step for micro_batches=1"
+    loss_name = getattr(loss_cfg, "name", "milnce")
     compute_dtype = jnp.dtype(getattr(model, "dtype", jnp.float32))
 
     def local_step(state: TrainState, video_u8, text_ids, start):
-        del start
         b = video_u8.shape[0]
         assert b % micro_batches == 0, (b, micro_batches)
         bm = b // micro_batches
@@ -107,9 +116,10 @@ def make_grad_cache_step(model, optimizer, mesh: Mesh,
 
         def fwd(params, batch_stats, vu8, tids):
             video = vu8.astype(compute_dtype) / jnp.asarray(255, compute_dtype)
+            mode = {} if loss_name == "milnce" else {"mode": "sequence"}
             return model.apply({"params": params, "batch_stats": batch_stats},
                                video, tids, train=True,
-                               mutable=["batch_stats"])
+                               mutable=["batch_stats"], **mode)
 
         # pass 1: embed every microbatch, cache embeddings only
         def embed_one(_, xs):
@@ -118,19 +128,27 @@ def make_grad_cache_step(model, optimizer, mesh: Mesh,
             return None, (v, t, mutated["batch_stats"])
 
         _, (v_mb, t_mb, stats_mb) = lax.scan(embed_one, None, (vids, txts))
-        v_local = v_mb.reshape(b, -1)
-        t_local = t_mb.reshape(b * k_rows, -1)
+        # (M, bm, ...) -> (b, ...): pooled (b, D) or sequence (b, T', D)
+        v_local = v_mb.reshape((b,) + v_mb.shape[2:])
+        t_local = t_mb.reshape((b * k_rows,) + t_mb.shape[2:])
 
         # loss + gradients w.r.t. the cached embeddings (mesh-global
-        # negatives exactly as the single-pass step)
+        # negatives/pairs exactly as the single-pass step)
+        if loss_name == "milnce":
+            def loss_of(v, t):
+                return milnce_loss(v, t, axis_name=data_axis)
+        else:
+            def loss_of(v, t):
+                t_seq = t.reshape(b, -1, t.shape[-1])      # (B, K, D)
+                return _sequence_loss(loss_cfg, v, t_seq, start, data_axis)
+
         loss, (g_v, g_t) = jax.value_and_grad(
-            lambda v, t: milnce_loss(v, t, axis_name=data_axis),
-            argnums=(0, 1))(v_local, t_local)
+            loss_of, argnums=(0, 1))(v_local, t_local)
 
         # pass 2: re-forward each microbatch, seed its VJP with the
         # cached embedding grads, accumulate parameter grads
-        g_v_mb = g_v.reshape(micro_batches, bm, -1)
-        g_t_mb = g_t.reshape(micro_batches, bm * k_rows, -1)
+        g_v_mb = g_v.reshape((micro_batches, bm) + g_v.shape[1:])
+        g_t_mb = g_t.reshape((micro_batches, bm * k_rows) + g_t.shape[1:])
 
         def grad_one(acc, xs):
             vu8, tids, gv, gt = xs
@@ -146,7 +164,8 @@ def make_grad_cache_step(model, optimizer, mesh: Mesh,
         zero = jax.tree_util.tree_map(jnp.zeros_like, state.params)
         grads, _ = lax.scan(grad_one, zero, (vids, txts, g_v_mb, g_t_mb))
 
-        grads = lax.psum(grads, data_axis)
+        reduce = lax.psum if loss_name == "milnce" else lax.pmean
+        grads = reduce(grads, data_axis)
         # merge BN stats over microbatches then shards: a microbatch is a
         # virtual shard, so mean-of-means matches the M*N-chip run
         new_stats = jax.tree_util.tree_map(

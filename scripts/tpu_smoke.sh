@@ -26,13 +26,16 @@ echo "    remote_compile port refused connections 33 min after a healthy probe) 
 timeout 240 python -c "import jax, jax.numpy as jnp; print(float(jax.jit(lambda: jnp.ones(4).sum())()))" \
   || { echo "accelerator lost mid-queue — skipping the train-loop cross-check (bench rows above are still valid)"; exit 0; }
 
-echo "=== train-loop cross-check (batch 256, 12 steps, synthetic) ==="
+echo "=== train-loop cross-check (batch 128, 12 steps, synthetic) ==="
+# batch 128 = the measured operating point (BENCH_NOTES.md); the 256
+# compile wedged the tunnel twice on 2026-07-31, and this step has no
+# watchdog (a timeout-kill of a live client is what causes the wedge)
 RUNDIR="$(mktemp -d)"
 cd "$RUNDIR"
 PYTHONPATH="$REPO" python -m milnce_tpu.train.cli --preset small \
-  --data.synthetic true --data.synthetic_num_samples 3072 \
+  --data.synthetic true --data.synthetic_num_samples 1536 \
   --data.num_frames 16 --data.max_words 20 \
-  --train.batch_size 256 --model.dtype bfloat16 \
+  --train.batch_size 128 --model.dtype bfloat16 \
   --train.max_steps 12 --train.n_display 4 \
   | grep -E "Training loss|Throughput|done:"
 echo "=== done (run dir: $RUNDIR) ==="

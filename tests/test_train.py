@@ -322,10 +322,11 @@ def test_train_step_on_two_axis_mesh():
 
 
 class TestGradCache:
-    """Two-pass embedding-cache MIL-NCE (train/step.py
-    make_grad_cache_step): M microbatches on N chips must equal one
-    microbatch on M*N chips — a microbatch IS a virtual data-parallel
-    shard (per-microbatch BN == the reference's per-GPU local BN)."""
+    """Two-pass embedding-cache contrastive step (train/step.py
+    make_grad_cache_step), for MIL-NCE and the DTW family: M microbatches
+    on N chips must equal one microbatch on M*N chips — a microbatch IS
+    a virtual data-parallel shard (per-microbatch BN == the reference's
+    per-GPU local BN)."""
 
     def _setup(self, n_text_candidates=2):
         import jax
@@ -389,6 +390,51 @@ class TestGradCache:
         stats8 = jax.tree_util.tree_leaves(s8.batch_stats)
         stats4 = jax.tree_util.tree_leaves(s4.batch_stats)
         for a8, a4 in zip(stats8, stats4):
+            np.testing.assert_allclose(np.asarray(a4), np.asarray(a8),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_microbatch_equals_virtual_shard_dtw(self):
+        """The embedding-cache step covers the fork's DTW losses too:
+        pass 1 caches SEQUENCE embeddings (B, T', D), the gathered
+        replicated loss seeds the VJP, grads pmean-reduced — 2
+        microbatches on 4 chips == 1 microbatch on 8 chips."""
+        import jax
+        import numpy as onp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from milnce_tpu.config import LossConfig
+        from milnce_tpu.train.step import (make_grad_cache_step,
+                                           make_train_step)
+
+        model, optimizer, state, video, text, b = self._setup()
+        devices = jax.devices()
+        assert len(devices) >= 8
+        loss_cfg = LossConfig(name="cdtw")
+        start = onp.linspace(0.0, 30.0, b).astype(onp.float32)
+
+        mesh8 = Mesh(onp.asarray(devices[:8]), ("data",))
+        step8 = make_train_step(model, optimizer, mesh8, donate=False,
+                                loss_cfg=loss_cfg)
+        sh8 = NamedSharding(mesh8, P("data"))
+        s8, loss8 = step8(state, jax.device_put(video, sh8),
+                          jax.device_put(text, sh8),
+                          jax.device_put(start, sh8))
+
+        mesh4 = Mesh(onp.asarray(devices[:4]), ("data",))
+        gc = make_grad_cache_step(model, optimizer, mesh4, micro_batches=2,
+                                  donate=False, loss_cfg=loss_cfg)
+        sh4 = NamedSharding(mesh4, P("data"))
+        s4, loss4 = gc(state, jax.device_put(video, sh4),
+                       jax.device_put(text, sh4),
+                       jax.device_put(start, sh4))
+
+        np.testing.assert_allclose(float(loss4), float(loss8), rtol=1e-5)
+        for a8, a4 in zip(jax.tree_util.tree_leaves(s8.params),
+                          jax.tree_util.tree_leaves(s4.params)):
+            np.testing.assert_allclose(np.asarray(a4), np.asarray(a8),
+                                       rtol=2e-4, atol=2e-5)
+        for a8, a4 in zip(jax.tree_util.tree_leaves(s8.batch_stats),
+                          jax.tree_util.tree_leaves(s4.batch_stats)):
             np.testing.assert_allclose(np.asarray(a4), np.asarray(a8),
                                        rtol=1e-4, atol=1e-5)
 
