@@ -200,13 +200,18 @@ def _step_flops(step_fn, args):
 def _bench_config(dtype: str, batch: int, frames: int, size: int,
                   words: int, k: int, remat: bool,
                   inner: int = 1, s2d: bool = False,
-                  conv_impl: str = "native",
+                  conv_impl: str = "native", loss: str = "milnce",
                   peak: float | None = None, flops_hint: float | None = None):
     """Time the full train step at one operating point.
 
     ``inner`` optimizer steps run inside ONE XLA program per dispatch
     (lax.scan in make_train_step) so per-dispatch host latency — seconds
     over a remote TPU tunnel — doesn't masquerade as device time.
+    ``loss`` selects the trained loss: 'milnce' (headline) or a DTW
+    family name ('sdtw_3', 'cdtw', ...) with ``sdtw_backend='auto'`` —
+    the Pallas kernel inside the full compiled train step.  FLOPs/MFU
+    are reported for milnce only (the analytic model doesn't count the
+    alignment DP).
     Returns dict with clips/sec/chip (+flops) or raises on OOM."""
     import jax
     import jax.numpy as jnp
@@ -226,9 +231,14 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
     model = build_model(cfg.model)
     mesh = build_mesh(cfg.parallel)
 
+    loss_cfg = None
+    if loss != "milnce":
+        cfg.loss.name = loss
+        cfg.loss.sdtw_backend = "auto"   # Pallas where the measured
+        loss_cfg = cfg.loss              # crossover says it wins
     optimizer = build_optimizer(cfg.optim, build_schedule(cfg.optim, 1000))
     step_fn = make_train_step(model, optimizer, mesh, donate=False,
-                              inner_steps=inner)
+                              inner_steps=inner, loss_cfg=loss_cfg)
 
     # Everything below runs ON DEVICE in three jitted programs.  The
     # obvious host-side version (eager model.init + optimizer.init +
@@ -260,7 +270,11 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
         make_inputs, out_shardings=(data_sh, data_sh, data_sh))(
             jax.random.PRNGKey(1))
 
-    if flops_hint is not None:
+    if loss != "milnce":
+        # neither the hint nor the analytic model counts the alignment
+        # DP; report raw throughput without an MFU for DTW rows
+        flops, flops_source = None, None
+    elif flops_hint is not None:
         # Seeded from an earlier XLA-counted config of the same plan (see
         # run_bench's hint(), which rescales model and logits terms
         # separately) — avoids another full-model compile over the tunnel
@@ -288,9 +302,10 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
             flops_source = "analytic"
             _note(f"bench: using analytic FLOPs model ({flops:.3e}/step)")
 
-    # warmup / compile
-    state, loss = step_fn(state, video_d, text_d, start_d)
-    float(loss)
+    # warmup / compile (NOT `loss` — that name is the loss-selector arg
+    # and ends up verbatim in the result record)
+    state, warmup_loss = step_fn(state, video_d, text_d, start_d)
+    float(warmup_loss)
 
     def wall(n_dispatch: int) -> float:
         nonlocal state
@@ -346,6 +361,7 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
         "remat": remat,
         "s2d": s2d,
         "conv_impl": conv_impl,
+        "loss": loss,
         "inner": inner,
         "step_ms": round(dt / inner * 1e3, 2),
         "clips_per_sec_per_chip": round(batch * inner / dt / n_chips, 3),
@@ -526,12 +542,12 @@ def run_bench(on_tpu: bool, info: dict):
         linear = f0 - milnce_logits_flops(b0, k)
         return linear * batch / b0 + milnce_logits_flops(batch, k)
 
-    def measure(dtype, batch, remat, s2d, conv_impl):
+    def measure(dtype, batch, remat, s2d, conv_impl, loss="milnce"):
         return _run_config(
             timeout_s=cfg_timeout, platform_pin=None if on_tpu else "cpu",
             dtype=dtype, batch=batch, frames=frames,
             size=size, words=words, k=k, remat=remat, inner=inner, s2d=s2d,
-            conv_impl=conv_impl, peak=peak,
+            conv_impl=conv_impl, loss=loss, peak=peak,
             flops_hint=hint(dtype, remat, s2d, batch))
 
     def tunnel_wedged(exc) -> bool:
@@ -592,8 +608,12 @@ def run_bench(on_tpu: bool, info: dict):
             _emit(_make_record(
                 max(results, key=lambda x: x["clips_per_sec_per_chip"]),
                 frames, size, on_tpu, kind))
-            # stop climbing once throughput flattens (<3% gain): HBM knee
-            if r["clips_per_sec_per_chip"] < prev * 1.03:
+            # stop climbing only once throughput actually DECLINES (or
+            # goes flat): with 192 interposed in the ladder a healthy
+            # 128->256 climb splits into two small steps, and a
+            # percentage threshold here would end the plan before
+            # 256/384 ever ran
+            if r["clips_per_sec_per_chip"] <= prev:
                 break
             prev = r["clips_per_sec_per_chip"]
 
@@ -617,7 +637,11 @@ def run_bench(on_tpu: bool, info: dict):
             r = measure(**kw)
             _note(f"bench: {r}")
             results.append(r)
-            best = max(results, key=lambda x: x["clips_per_sec_per_chip"])
+            # comparison rows with a different loss are slower by design
+            # (more work per clip) and must not displace the headline
+            best = max((x for x in results
+                        if x.get("loss", "milnce") == "milnce"),
+                       key=lambda x: x["clips_per_sec_per_chip"])
             _emit(_make_record(best, frames, size, on_tpu, kind))
         except Exception as exc:
             dead = tunnel_wedged(exc)
@@ -636,6 +660,12 @@ def run_bench(on_tpu: bool, info: dict):
     if (on_tpu and conv_impl == "native"
             and os.environ.get("MILNCE_BENCH_FOLD2D") != "0"):
         extra_row("fold2d", conv_impl="fold2d")
+    # DTW-family row: the Pallas soft-DTW kernel inside the FULL compiled
+    # train step (loss sdtw_3, backend auto) at the winning operating
+    # point — the fork's signature loss measured on the real chip, not
+    # just in the kernel microbench (opt out: MILNCE_BENCH_SDTW=0).
+    if on_tpu and os.environ.get("MILNCE_BENCH_SDTW") != "0":
+        extra_row("sdtw_3", loss="sdtw_3", s2d=False, conv_impl="native")
 
     _write_notes(results, best, kind, on_tpu, n_devices,
                  truncated=dead)
@@ -662,12 +692,13 @@ def _write_notes(results, best, kind, on_tpu, n_chips, truncated=False):
                  f"- chosen operating point: dtype={best['dtype']} "
                  f"batch={best['batch']} remat={best['remat']} -> "
                  f"{best['clips_per_sec_per_chip']} clips/sec/chip",
-                 "", "| dtype | batch | remat | s2d | conv | step_ms | clips/s/chip | MFU |",
-                 "|---|---|---|---|---|---|---|---|"]
+                 "", "| dtype | batch | remat | s2d | conv | loss | step_ms | clips/s/chip | MFU |",
+                 "|---|---|---|---|---|---|---|---|---|"]
         for r in results:
             lines.append(f"| {r['dtype']} | {r['batch']} | {r['remat']} | "
                          f"{r.get('s2d', False)} | "
                          f"{r.get('conv_impl', 'native')} | "
+                         f"{r.get('loss', 'milnce')} | "
                          f"{r['step_ms']} | {r['clips_per_sec_per_chip']} | "
                          f"{r.get('mfu', '-')} |")
         if truncated:

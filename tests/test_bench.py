@@ -94,6 +94,22 @@ class TestConfigChild:
         assert "no_such_dtype" in str(exc_info.value) or "TypeError" in str(
             exc_info.value) or "dtype" in str(exc_info.value)
 
+    def test_dtw_row_serializes_with_loss_tag(self):
+        # the sdtw_3 comparison row: result must round-trip through the
+        # tagged-JSON protocol (regression: the warmup loss scalar once
+        # shadowed the loss-name arg -> ArrayImpl in the record) and
+        # carry no MFU/FLOPs (the analytic model doesn't count the DP)
+        # batch must divide the forced 8-device CPU mesh the child sees
+        r = bench._run_config(timeout_s=600, platform_pin="cpu",
+                              dtype="float32", batch=16, frames=4, size=32,
+                              words=4, k=2, remat=False, inner=1, s2d=False,
+                              conv_impl="native", loss="sdtw_3", peak=None,
+                              flops_hint=None)
+        assert r["loss"] == "sdtw_3"
+        assert r["flops_per_step"] is None and "mfu" not in r
+        assert r["clips_per_sec_per_chip"] > 0
+        json.dumps(r)
+
     def test_run_config_timeout_is_tagged(self):
         # a child that cannot finish inside the watchdog raises the
         # 'config timeout' marker the sweep's wedge detection keys on
