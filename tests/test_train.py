@@ -493,3 +493,76 @@ def test_boundary_stop_resumes_as_epoch_complete(tiny_cfg, tmp_path):
     assert second.steps == 0, (
         f"boundary stop retrained the epoch: ran {second.steps} steps")
     assert int(second.state.step) == 4
+
+
+@pytest.mark.slow
+def test_end_to_end_learning_retrieval():
+    """The whole learning system works: train MIL-NCE on the synthetic
+    source's deterministic video<->text pairs and zero-shot retrieval
+    R@1 over the trained set rises from chance (1/32) to a majority —
+    forward, gather, loss, grads, Adam, BN stats, and both embed paths
+    all pulling in the same direction.  (The only convergence evidence
+    possible without the dataset; the reference has no equivalent.)"""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from milnce_tpu.config import DataConfig, OptimConfig
+    from milnce_tpu.data.synthetic import SyntheticVideoTextSource
+    from milnce_tpu.eval.metrics import compute_retrieval_metrics
+    from milnce_tpu.models import S3D
+    from milnce_tpu.train.schedule import build_schedule
+    from milnce_tpu.train.state import build_optimizer, create_train_state
+    from milnce_tpu.train.step import (make_text_embed_fn, make_train_step,
+                                       make_video_embed_fn)
+
+    n, k, words, frames, size = 32, 2, 6, 4, 32
+    dcfg = DataConfig(num_frames=frames, video_size=size, num_candidates=k,
+                      max_words=words, synthetic_num_samples=n)
+    src = SyntheticVideoTextSource(dcfg, vocab_size=64, num_samples=n)
+    rng = onp.random.RandomState(0)
+    samples = [src.sample(i, rng) for i in range(n)]
+    videos = onp.stack([s["video"] for s in samples])
+    texts = onp.concatenate([s["text"] for s in samples])
+    starts = onp.zeros((n,), onp.float32)
+
+    model = S3D(num_classes=16, vocab_size=64, word_embedding_dim=8,
+                text_hidden_dim=16, inception_blocks=1)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, frames, size, size, 3), jnp.float32),
+                           jnp.zeros((2 * k, words), jnp.int32))
+    ocfg = OptimConfig(lr=1e-3, warmup_steps=5)
+    optimizer = build_optimizer(ocfg, build_schedule(ocfg, 200))
+    state = create_train_state(variables, optimizer)
+
+    mesh = Mesh(onp.asarray(jax.devices()[:8]), ("data",))
+    sh = NamedSharding(mesh, P("data"))
+    step = make_train_step(model, optimizer, mesh, donate=False)
+    v_d = jax.device_put(videos, sh)
+    t_d = jax.device_put(texts, sh)
+    s_d = jax.device_put(starts, sh)
+
+    embed_v = make_video_embed_fn(model, mesh)
+    embed_t = make_text_embed_fn(model, mesh)
+
+    def r_at_1(st):
+        var = {"params": st.params, "batch_stats": st.batch_stats}
+        v = onp.asarray(embed_v(var, v_d))
+        t = onp.asarray(embed_t(var, t_d)).reshape(n, k, -1).mean(axis=1)
+        return compute_retrieval_metrics(t @ v.T)["R1"]
+
+    before = r_at_1(state)
+    assert before <= 0.2, f"untrained R@1 {before} is already non-chance"
+
+    first_loss = None
+    for _ in range(120):
+        state, loss = step(state, v_d, t_d, s_d)
+        if first_loss is None:
+            first_loss = float(loss)
+    last_loss = float(loss)
+    after = r_at_1(state)
+
+    # prototype run (2026-07-31): 0.031 -> 0.56, loss 4.16 -> 0.70
+    assert last_loss < first_loss * 0.5, (first_loss, last_loss)
+    assert after >= 0.4, f"R@1 only reached {after} (before: {before})"
