@@ -21,6 +21,8 @@ for i in $(seq 1 60); do
     echo "=== stage probe (fold2d) ==="
     python scripts/stage_probe.py --batch 64 --dtype bfloat16 --conv_impl fold2d \
       && cp STAGE_PROBE.md STAGE_PROBE_fold2d.md
+    echo "=== XLA flag probe at the winning operating point ==="
+    python scripts/xla_flag_probe.py --batch 128
     echo "=== measurement queue done ($(date -u +%H:%M)) ==="
     exit 0
   fi

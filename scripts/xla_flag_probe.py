@@ -1,0 +1,123 @@
+"""XLA flag probe: re-measure the winning train-step operating point
+under candidate XLA:TPU flags.
+
+The measured MFU (18.1%, BENCH_NOTES.md) sits far under the analytic
+roofline ceiling (~63%, PERF.md) and the gap is scheduling/tiling —
+exactly the territory XLA flags move.  Each candidate flag set runs in
+its own watchdogged bench config child (bench._run_config: fresh
+process, own tunnel client, TERM-first stop), so a flag that wedges the
+compiler costs one timeout, and a flag the compiler rejects surfaces as
+a tagged error row, not a crash.
+
+    python scripts/xla_flag_probe.py                 # bf16 batch 128
+    python scripts/xla_flag_probe.py --batch 64 --timeout 600
+
+Writes one JSON line per flag set to stdout and XLA_FLAGS_PROBE.md
+(incrementally — a mid-probe tunnel wedge keeps the rows measured).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import bench  # noqa: E402
+
+# Candidate sets, each relative to the baseline flags the environment
+# already carries.  Conservative public knobs relevant to a single-chip
+# conv workload; collectives-oriented flags are pointless on one chip.
+CANDIDATES = [
+    ("baseline", ""),
+    # more scoped VMEM lets the conv emitter pick bigger tiles (the
+    # small-temporal-dim stages are exactly the ones starved for tile)
+    ("vmem_64m", "--xla_tpu_scoped_vmem_limit_kib=65536"),
+    ("vmem_128m", "--xla_tpu_scoped_vmem_limit_kib=131072"),
+    # overlap-oriented scheduler; mostly collectives but also reorders
+    # copies around the big fusions
+    ("latency_hiding", "--xla_tpu_enable_latency_hiding_scheduler=true"),
+    # both together
+    ("vmem_128m+lhs", "--xla_tpu_scoped_vmem_limit_kib=131072 "
+     "--xla_tpu_enable_latency_hiding_scheduler=true"),
+]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--frames", type=int, default=16)
+    ap.add_argument("--size", type=int, default=224)
+    ap.add_argument("--timeout", type=float, default=900.0)
+    args = ap.parse_args()
+
+    # TERMing this probe must reach the live measurement grand-child
+    # (bench's own child mode registers the same forwarder)
+    import signal
+
+    signal.signal(signal.SIGTERM, bench._forward_term_and_exit)
+
+    cpu = os.environ.get("MILNCE_FLAGPROBE_CPU") == "1"
+    if cpu:
+        peak, pin = None, "cpu"       # sanity run on tiny shapes
+        args.frames, args.size, args.batch = 2, 32, 8
+    else:
+        probe = bench._probe_backend()
+        if not probe or probe.get("platform") not in ("tpu", "axon"):
+            # a healthy CPU backend is still the wrong instrument: five
+            # 900s full-size S3D steps on host CPU would write rows that
+            # read as TPU results
+            print(json.dumps({"error": "no TPU backend", "probe": probe}))
+            sys.exit(1)
+        peak, pin = bench._peak_flops(str(probe.get("kind", ""))), None
+
+    base_flags = os.environ.get("XLA_FLAGS", "")
+    rows = []
+    for name, flags in CANDIDATES:
+        os.environ["XLA_FLAGS"] = (base_flags + " " + flags).strip()
+        try:
+            r = bench._run_config(
+                timeout_s=args.timeout, platform_pin=pin,
+                dtype=args.dtype, batch=args.batch,
+                frames=args.frames, size=args.size, words=20, k=5,
+                remat=False, inner=4 if not cpu else 1, s2d=False,
+                conv_impl="native", peak=peak, flops_hint=None)
+            row = {"name": name, "flags": flags,
+                   "clips_per_sec_per_chip": r["clips_per_sec_per_chip"],
+                   "step_ms": r["step_ms"], "mfu": r.get("mfu")}
+        except Exception as exc:
+            row = {"name": name, "flags": flags,
+                   "error": f"{type(exc).__name__}: {exc}"}
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+        _write_md(rows, args)
+    os.environ["XLA_FLAGS"] = base_flags
+
+
+def _write_md(rows, args) -> None:
+    lines = [
+        "# XLA flag probe (auto-written by scripts/xla_flag_probe.py)", "",
+        f"- config: {args.dtype} batch={args.batch} "
+        f"{args.frames}f@{args.size}^2, full train step, differenced "
+        "timing (4 inner steps/dispatch)",
+        "", "| name | flags | step_ms | clips/s/chip | MFU |",
+        "|---|---|---|---|---|",
+    ]
+    for r in rows:
+        if "error" in r:
+            lines.append(f"| {r['name']} | `{r['flags'] or '(none)'}` | "
+                         f"error: {r['error'][:80]} | | |")
+        else:
+            lines.append(f"| {r['name']} | `{r['flags'] or '(none)'}` | "
+                         f"{r['step_ms']} | {r['clips_per_sec_per_chip']} | "
+                         f"{r.get('mfu', '-')} |")
+    with open(os.path.join(_REPO, "XLA_FLAGS_PROBE.md"), "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+
+
+if __name__ == "__main__":
+    main()
