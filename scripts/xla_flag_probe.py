@@ -12,8 +12,10 @@ a tagged error row, not a crash.
     python scripts/xla_flag_probe.py                 # bf16 batch 128
     python scripts/xla_flag_probe.py --batch 64 --timeout 600
 
-Writes one JSON line per flag set to stdout and XLA_FLAGS_PROBE.md
-(incrementally — a mid-probe tunnel wedge keeps the rows measured).
+Writes one JSON line per flag set to stdout and (TPU runs only)
+XLA_FLAGS_PROBE.md, incrementally — a mid-probe tunnel wedge keeps the
+rows measured, stops the remaining candidates, and marks the artifact
+truncated.
 """
 
 from __future__ import annotations
@@ -77,6 +79,7 @@ def main() -> None:
 
     base_flags = os.environ.get("XLA_FLAGS", "")
     rows = []
+    truncated = False
     for name, flags in CANDIDATES:
         os.environ["XLA_FLAGS"] = (base_flags + " " + flags).strip()
         try:
@@ -94,11 +97,27 @@ def main() -> None:
                    "error": f"{type(exc).__name__}: {exc}"}
         print(json.dumps(row), flush=True)
         rows.append(row)
-        _write_md(rows, args)
+        if not cpu:
+            _write_md(rows, args, truncated)
+        if "error" in row and "config timeout" in row["error"] and not cpu:
+            # the timed-out compile may have wedged the tunnel (the
+            # batch-256 failure mode): without this re-probe every later
+            # candidate would burn its full timeout and be recorded as a
+            # flag failure it never earned (bench.run_bench does the same)
+            os.environ["XLA_FLAGS"] = base_flags
+            if not bench._probe_backend():
+                truncated = True
+                _write_md(rows, args, truncated)
+                print(json.dumps({"error": "tunnel wedged mid-probe; "
+                                  "remaining candidates not tested"}))
+                break
     os.environ["XLA_FLAGS"] = base_flags
 
 
-def _write_md(rows, args) -> None:
+def _write_md(rows, args, truncated=False) -> None:
+    # TPU runs only (callers gate on `cpu`): a sanity run must never
+    # clobber a real-chip artifact — same rule as bench._write_notes
+    # and stage_probe
     lines = [
         "# XLA flag probe (auto-written by scripts/xla_flag_probe.py)", "",
         f"- config: {args.dtype} batch={args.batch} "
@@ -107,6 +126,10 @@ def _write_md(rows, args) -> None:
         "", "| name | flags | step_ms | clips/s/chip | MFU |",
         "|---|---|---|---|---|",
     ]
+    if truncated:
+        lines.insert(3, "- **PROBE TRUNCATED**: the tunnel wedged "
+                     "mid-probe; rows below are what was measured, "
+                     "remaining candidates were NOT tested.")
     for r in rows:
         if "error" in r:
             lines.append(f"| {r['name']} | `{r['flags'] or '(none)'}` | "

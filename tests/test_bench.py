@@ -65,6 +65,41 @@ class TestMakeRecord:
         assert "s2d stem" in rec["metric"]
 
 
+def test_wedge_truncation_marks_partial(monkeypatch):
+    """A config timeout followed by a dead re-probe must stop the sweep
+    immediately, keep the measured rows, and stamp the final record with
+    the partial marker (the orchestrator exits 0, so the parent's
+    timeout marker never fires for this case)."""
+    row = {"dtype": "bfloat16", "batch": 64, "remat": False, "s2d": False,
+           "conv_impl": "native", "loss": "milnce", "inner": 4,
+           "step_ms": 100.0, "clips_per_sec_per_chip": 50.0,
+           "flops_per_step": None, "flops_source": None,
+           "flops_per_sec": None}
+    calls = {"n": 0}
+
+    def fake_run_config(timeout_s=None, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return dict(row, batch=kw["batch"])
+        raise RuntimeError(f"config timeout>{timeout_s}s: {kw}")
+
+    recs = []
+    monkeypatch.setattr(bench, "_run_config", fake_run_config)
+    monkeypatch.setattr(bench, "_probe_backend", lambda *a, **k: None)
+    monkeypatch.setattr(bench, "_emit", recs.append)
+    monkeypatch.setattr(bench, "_write_notes",
+                        lambda *a, **k: None)   # don't clobber the artifact
+
+    rec = bench.run_bench(True, {"platform": "tpu", "kind": "TPU v5 lite",
+                                 "n": 1})
+    assert rec["partial"] == "tunnel wedged mid-sweep"
+    assert rec["value"] == 50.0
+    assert rec["on_tpu"] is True
+    # wedge detected on call 2: no remat retry, no f32 plan, no extra rows
+    assert calls["n"] == 2
+    assert recs, "interim record for the measured row was never streamed"
+
+
 def test_peak_flops_lookup():
     assert bench._peak_flops("TPU v5 lite") == 197e12
     assert bench._peak_flops("TPU v4") == 275e12
