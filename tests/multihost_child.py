@@ -1,0 +1,99 @@
+"""Child process + shared fixtures for test_multihost.py.
+
+As __main__: join a 2-process jax.distributed cluster over loopback
+(Gloo CPU collectives), run ONE sharded train step on a global mesh
+spanning both processes, print the loss as JSON.  This is the real
+multi-host path (parallel/mesh.py initialize_distributed with an
+explicit coordinator — the replacement for the reference's hardcoded-IP
+rendezvous, train.py:48-56), not the single-host no-op.
+
+As a module: exposes the EXACT shapes/model/data used by the child so
+the parent test's in-process cross-check consumes one definition
+(import is side-effect-free; jax.config mutations happen only in
+main()).
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+B_LOCAL, NPROCS, K, FRAMES, SIZE, WORDS = 2, 2, 2, 4, 32, 5
+B_GLOBAL = B_LOCAL * NPROCS
+
+
+def global_batch():
+    """Identical deterministic global batch on every process; each holds
+    its own slice (exactly the per-host loader contract)."""
+    rng = np.random.RandomState(0)
+    video = rng.randint(0, 255, (B_GLOBAL, FRAMES, SIZE, SIZE, 3), np.uint8)
+    text = rng.randint(0, 32, (B_GLOBAL * K, WORDS)).astype(np.int32)
+    start = np.zeros((B_GLOBAL,), np.float32)
+    return video, text, start
+
+
+def build_model_and_state():
+    import jax
+    import jax.numpy as jnp
+
+    from milnce_tpu.config import OptimConfig
+    from milnce_tpu.models import S3D
+    from milnce_tpu.train.schedule import build_schedule
+    from milnce_tpu.train.state import build_optimizer, create_train_state
+
+    model = S3D(num_classes=16, vocab_size=32, word_embedding_dim=8,
+                text_hidden_dim=16, inception_blocks=1)
+    variables = jax.jit(lambda key: model.init(
+        key, jnp.zeros((2, FRAMES, SIZE, SIZE, 3), jnp.float32),
+        jnp.zeros((2 * K, WORDS), jnp.int32)))(jax.random.PRNGKey(0))
+    ocfg = OptimConfig(warmup_steps=2)
+    optimizer = build_optimizer(ocfg, build_schedule(ocfg, 10))
+    return model, optimizer, create_train_state(variables, optimizer)
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # older jax: default implementation
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from milnce_tpu.config import ParallelConfig
+    from milnce_tpu.parallel.mesh import build_mesh, initialize_distributed
+    from milnce_tpu.train.step import make_train_step
+
+    pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    assert nprocs == NPROCS, (nprocs, NPROCS)
+    pcfg = ParallelConfig(coordinator_address=f"127.0.0.1:{port}",
+                          num_processes=nprocs, process_id=pid)
+    initialize_distributed(pcfg)
+    assert jax.process_count() == nprocs, jax.process_count()
+
+    video, text, start = global_batch()
+    model, optimizer, state = build_model_and_state()
+
+    mesh = build_mesh(pcfg)             # spans BOTH processes' devices
+    sharding = NamedSharding(mesh, P("data"))
+    lo, hi = pid * B_LOCAL, (pid + 1) * B_LOCAL
+    video_g = jax.make_array_from_process_local_data(sharding, video[lo:hi])
+    text_g = jax.make_array_from_process_local_data(
+        sharding, text[lo * K:hi * K])
+    start_g = jax.make_array_from_process_local_data(sharding, start[lo:hi])
+
+    step = make_train_step(model, optimizer, mesh, donate=False)
+    _, loss = step(state, video_g, text_g, start_g)
+    print(json.dumps({"process": pid, "loss": float(loss)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
