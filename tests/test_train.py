@@ -438,15 +438,18 @@ class TestGradCache:
             np.testing.assert_allclose(np.asarray(a4), np.asarray(a8),
                                        rtol=1e-4, atol=1e-5)
 
-    def test_loop_integration(self, tiny_cfg, tmp_path):
-        """grad_accum=2 trains through run_training end to end."""
+    @pytest.mark.parametrize("loss_name", ["milnce", "cdtw"])
+    def test_loop_integration(self, tiny_cfg, tmp_path, loss_name):
+        """grad_accum=2 trains through run_training end to end — for the
+        MIL-NCE and the DTW-family paths of the embedding-cache step."""
         from milnce_tpu.train.loop import run_training
 
         import copy
 
         cfg = copy.deepcopy(tiny_cfg)    # module-scoped fixture: don't mutate
-        cfg.train.checkpoint_root = str(tmp_path / "ckpt_gc")
+        cfg.train.checkpoint_root = str(tmp_path / f"ckpt_gc_{loss_name}")
         cfg.train.grad_accum = 2
+        cfg.loss.name = loss_name
         # per-shard batch must split into grad_accum microbatches
         cfg.train.batch_size = 16
         result = run_training(cfg, max_steps=2)
