@@ -815,8 +815,15 @@ def main():
         probe_info = _probe_backend()
         if probe_info:
             # Even a healthy-probing tunnel can wedge mid-sweep; bound the
-            # whole TPU run and fall back rather than hang the gate.
-            budget = float(os.environ.get("MILNCE_BENCH_TPU_TIMEOUT", "2400"))
+            # whole TPU run and fall back rather than hang the gate.  A
+            # full sweep with two cold compiles and one wedged-config cap
+            # is ~65 min, so the default budget must clear ~3900s.
+            # Interim records stream to stdout as they land, so if an
+            # OUTER timeout kills this parent first no measurement is
+            # lost — but the kill skips _graceful_stop and can still
+            # wedge the tunnel for LATER clients, so prefer setting
+            # MILNCE_BENCH_TPU_TIMEOUT below any outer deadline.
+            budget = float(os.environ.get("MILNCE_BENCH_TPU_TIMEOUT", "4500"))
             rec, status = run_child("tpu", timeout=budget,
                                     device_info=probe_info)
             if rec is not None:
