@@ -14,15 +14,19 @@ cd "$(dirname "$0")/.."
 for i in $(seq 1 60); do
   if timeout 240 python -c "import jax, jax.numpy as jnp; print(float(jax.jit(lambda: jnp.ones(4).sum())()))" >/dev/null 2>&1; then
     echo "=== tunnel healthy (probe $i, $(date -u +%H:%M)) — running measurement queue ==="
-    bash scripts/tpu_smoke.sh
+    # Unique diagnostics FIRST: if the tunnel heals late in a round,
+    # only the head of this queue completes — and the round driver
+    # re-runs bench.py itself at round end, so the sweep goes last-ish.
     echo "=== stage probe (native) ==="
     python scripts/stage_probe.py --batch 64 --dtype bfloat16 --conv_impl native \
       && cp STAGE_PROBE.md STAGE_PROBE_native.md
+    echo "=== XLA flag probe at the winning operating point ==="
+    python scripts/xla_flag_probe.py --batch 128
+    echo "=== bench sweep + train cross-check ==="
+    bash scripts/tpu_smoke.sh
     echo "=== stage probe (fold2d) ==="
     python scripts/stage_probe.py --batch 64 --dtype bfloat16 --conv_impl fold2d \
       && cp STAGE_PROBE.md STAGE_PROBE_fold2d.md
-    echo "=== XLA flag probe at the winning operating point ==="
-    python scripts/xla_flag_probe.py --batch 128
     echo "=== measurement queue done ($(date -u +%H:%M)) ==="
     exit 0
   fi
