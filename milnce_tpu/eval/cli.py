@@ -38,24 +38,19 @@ def load_variables(ckpt: str, model, model_cfg: ModelConfig,
         import orbax.checkpoint as ocp
 
         from milnce_tpu.train.checkpoint import CheckpointManager
-        from milnce_tpu.train.schedule import cosine_with_warmup
-        from milnce_tpu.train.state import build_optimizer, create_train_state
-        from milnce_tpu.config import OptimConfig
 
-        video, text = sample_shapes
-        variables = model.init(jax.random.PRNGKey(0), video, text)
-        optimizer = build_optimizer(OptimConfig(),
-                                    cosine_with_warmup(1e-3, 1, 2))
-        template = create_train_state(variables, optimizer)
         # read-only: a mistyped path must raise, not mkdir itself and
-        # silently evaluate the freshly-initialized template weights
+        # silently evaluate freshly-initialized weights.  restore_raw
+        # takes shapes from the checkpoint's own metadata and reads only
+        # params/batch_stats — eval neither needs the optimizer state
+        # nor should break when its structure evolves (e.g. the masked
+        # frozen-embedding moments)
         mgr = CheckpointManager(ckpt, create=False)
-        if mgr.latest_epoch() is None:
-            raise FileNotFoundError(
-                f"no checkpoint saved under {ckpt!r} (empty or wrong run dir)")
-        epoch, state = mgr.restore_latest(template)
+        epoch, tree = mgr.restore_raw(subtrees={"params", "batch_stats"})
+        if not isinstance(tree, dict):   # a TrainState restored as object
+            tree = {"params": tree.params, "batch_stats": tree.batch_stats}
         print(f"loaded Orbax checkpoint (epoch {epoch}) from {ckpt}")
-        return {"params": state.params, "batch_stats": state.batch_stats}
+        return {"params": tree["params"], "batch_stats": tree["batch_stats"]}
     # torch formats
     from milnce_tpu.utils.torch_convert import load_torch_checkpoint_as_flax
 

@@ -26,11 +26,31 @@ class TrainState:
     opt_state: Any
 
 
+def _trainable_mask(params):
+    """False for the frozen word2vec table: its lookup is under
+    ``stop_gradient`` (reference parity, s3dg.py:199-200), so its grads
+    are structural zeros — optimizer moments for the ~20M-entry table
+    (~160 MB of HBM at the full vocab, 2x for Adam) would be waste the
+    reference never pays (torch's lazy per-param state never
+    materializes for no-grad params)."""
+    def trainable(path, _):
+        return not any(getattr(p, "key", None) == "word_embd" for p in path)
+
+    return jax.tree_util.tree_map_with_path(trainable, params)
+
+
 def build_optimizer(cfg: OptimConfig, schedule) -> optax.GradientTransformation:
     if cfg.name == "adam":
-        opt = optax.inject_hyperparams(optax.adam)(learning_rate=schedule)
+        def make_adam(learning_rate):
+            return optax.masked(optax.adam(learning_rate), _trainable_mask)
+
+        opt = optax.inject_hyperparams(make_adam)(learning_rate=schedule)
     elif cfg.name == "sgd":
-        opt = optax.inject_hyperparams(optax.sgd)(
+        def make_sgd(learning_rate, momentum):
+            return optax.masked(optax.sgd(learning_rate, momentum=momentum),
+                                _trainable_mask)
+
+        opt = optax.inject_hyperparams(make_sgd)(
             learning_rate=schedule, momentum=cfg.momentum)
     else:
         raise ValueError(f"unknown optimizer {cfg.name!r}")

@@ -127,6 +127,41 @@ def test_in_training_eval_task_validated_early(tiny_cfg):
         run_training(cfg, max_steps=1)
 
 
+def test_frozen_word2vec_has_no_optimizer_state():
+    """The word2vec table is frozen (stop_gradient lookup, reference
+    parity) — Adam/SGD must not allocate moments for it (~160 MB of HBM
+    at the full 66,250-word vocab; the reference's torch lazy per-param
+    state never materializes for no-grad params)."""
+    import jax
+    import jax.numpy as jnp
+
+    from milnce_tpu.config import OptimConfig
+    from milnce_tpu.models import S3D
+    from milnce_tpu.train.schedule import build_schedule
+    from milnce_tpu.train.state import build_optimizer, create_train_state
+
+    model = S3D(num_classes=16, vocab_size=32, word_embedding_dim=8,
+                text_hidden_dim=16, inception_blocks=1)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, 4, 32, 32, 3), jnp.float32),
+                           jnp.zeros((4, 5), jnp.int32))
+    table_shapes = {
+        tuple(leaf.shape)
+        for path, leaf in jax.tree_util.tree_leaves_with_path(
+            variables["params"])
+        if any(getattr(p, "key", None) == "word_embd" for p in path)}
+    assert table_shapes, "no word_embd params found — did the name change?"
+    for name in ("adam", "sgd"):
+        cfg = OptimConfig(name=name, warmup_steps=2)
+        opt = build_optimizer(cfg, build_schedule(cfg, 10))
+        state = create_train_state(variables, opt)
+        opt_shapes = [tuple(x.shape)
+                      for x in jax.tree_util.tree_leaves(state.opt_state)]
+        for shape in table_shapes:
+            assert shape not in opt_shapes, (
+                f"{name} allocated optimizer state for the frozen table")
+
+
 def test_schedule_matches_reference_shape():
     """Golden values of the cosine-warmup schedule (utils.py:26-38)."""
     import math
