@@ -35,8 +35,6 @@ def load_variables(ckpt: str, model, model_cfg: ModelConfig,
             f"checkpoint not found: {ckpt!r} (expected an Orbax run "
             "directory or a torch .pth/.pth.tar file)")
     if os.path.isdir(ckpt):
-        import orbax.checkpoint as ocp
-
         from milnce_tpu.train.checkpoint import CheckpointManager
 
         # read-only: a mistyped path must raise, not mkdir itself and

@@ -43,11 +43,49 @@ class CheckpointManager:
     def restore_latest(self, template: TrainState) -> Tuple[int, TrainState]:
         """Returns (next_epoch, state); (0, template) when nothing saved —
         mirrors get_last_checkpoint's empty-string fallback
-        (main_distributed.py:296-302)."""
+        (main_distributed.py:296-302).
+
+        If the stored tree's *optimizer* structure no longer matches the
+        template (the optimizer tree evolves across releases — e.g. the
+        ``optax.masked`` wrap for the frozen word2vec table changed
+        opt_state from AdamState to MaskedState), a full StandardRestore
+        fails.  Rather than strand an in-flight run, fall back to
+        restoring only ``step``/``params``/``batch_stats`` from the
+        checkpoint's own metadata and keep the template's freshly
+        initialized opt_state, logging that the optimizer moments were
+        dropped (a few hundred steps of Adam re-warmup, not a divergence)."""
         latest = self.latest_epoch()
         if latest is None:
             return 0, template
-        return latest, self.restore(latest, template)
+        try:
+            return latest, self.restore(latest, template)
+        except (ValueError, KeyError, TypeError) as exc:
+            import logging
+
+            import jax
+            import jax.numpy as jnp
+
+            _, raw = self.restore_raw(
+                latest, subtrees={"step", "params", "batch_stats"})
+            if not isinstance(raw, dict):  # a TrainState restored as object
+                raw = {"step": raw.step, "params": raw.params,
+                       "batch_stats": raw.batch_stats}
+            # Only an *optimizer* mismatch is rescuable.  If the stored
+            # params tree itself differs from the template's (model code
+            # changed, corrupt checkpoint), installing it would defer the
+            # crash to a confusing optax/jit error under a log line
+            # claiming a benign optimizer reinit — re-raise instead.
+            if (jax.tree_util.tree_structure(raw["params"])
+                    != jax.tree_util.tree_structure(template.params)):
+                raise
+            logging.getLogger(__name__).warning(
+                "checkpoint %d has an incompatible optimizer-state "
+                "structure (%s); restored weights only and reinitialized "
+                "the optimizer — Adam/SGD moments were dropped", latest, exc)
+            return latest, template.replace(
+                step=jnp.asarray(raw["step"]),
+                params=raw["params"],
+                batch_stats=raw.get("batch_stats", template.batch_stats))
 
     def restore_raw(self, epoch: Optional[int] = None,
                     subtrees: Optional[set] = None):
@@ -69,7 +107,11 @@ class CheckpointManager:
         if latest is None:
             raise FileNotFoundError("no checkpoint saved in this run dir")
         meta = self._mgr.item_metadata(latest)
-        shard = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        # local_devices, not devices: on a multi-host cluster devices()[0]
+        # belongs to process 0 only, and this path is reached by every
+        # process when restore_latest falls back on an optimizer-structure
+        # mismatch — a non-addressable sharding would crash the restore
+        shard = jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
         is_arr = lambda x: hasattr(x, "shape")  # noqa: E731
         template = jax.tree_util.tree_map(
             lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=shard)

@@ -80,38 +80,42 @@ def main() -> None:
     base_flags = os.environ.get("XLA_FLAGS", "")
     rows = []
     truncated = False
-    for name, flags in CANDIDATES:
-        os.environ["XLA_FLAGS"] = (base_flags + " " + flags).strip()
-        try:
-            r = bench._run_config(
-                timeout_s=args.timeout, platform_pin=pin,
-                dtype=args.dtype, batch=args.batch,
-                frames=args.frames, size=args.size, words=20, k=5,
-                remat=False, inner=4 if not cpu else 1, s2d=False,
-                conv_impl="native", peak=peak, flops_hint=None)
-            row = {"name": name, "flags": flags,
-                   "clips_per_sec_per_chip": r["clips_per_sec_per_chip"],
-                   "step_ms": r["step_ms"], "mfu": r.get("mfu")}
-        except Exception as exc:
-            row = {"name": name, "flags": flags,
-                   "error": f"{type(exc).__name__}: {exc}"}
-        print(json.dumps(row), flush=True)
-        rows.append(row)
-        if not cpu:
-            _write_md(rows, args, truncated)
-        if "error" in row and "config timeout" in row["error"] and not cpu:
-            # the timed-out compile may have wedged the tunnel (the
-            # batch-256 failure mode): without this re-probe every later
-            # candidate would burn its full timeout and be recorded as a
-            # flag failure it never earned (bench.run_bench does the same)
-            os.environ["XLA_FLAGS"] = base_flags
-            if not bench._probe_backend():
-                truncated = True
+    try:
+        for name, flags in CANDIDATES:
+            os.environ["XLA_FLAGS"] = (base_flags + " " + flags).strip()
+            try:
+                r = bench._run_config(
+                    timeout_s=args.timeout, platform_pin=pin,
+                    dtype=args.dtype, batch=args.batch,
+                    frames=args.frames, size=args.size, words=20, k=5,
+                    remat=False, inner=4 if not cpu else 1, s2d=False,
+                    conv_impl="native", peak=peak, flops_hint=None)
+                row = {"name": name, "flags": flags,
+                       "clips_per_sec_per_chip": r["clips_per_sec_per_chip"],
+                       "step_ms": r["step_ms"], "mfu": r.get("mfu")}
+            except Exception as exc:
+                row = {"name": name, "flags": flags,
+                       "error": f"{type(exc).__name__}: {exc}"}
+            print(json.dumps(row), flush=True)
+            rows.append(row)
+            if not cpu:
                 _write_md(rows, args, truncated)
-                print(json.dumps({"error": "tunnel wedged mid-probe; "
-                                  "remaining candidates not tested"}))
-                break
-    os.environ["XLA_FLAGS"] = base_flags
+            if "error" in row and "config timeout" in row["error"] and not cpu:
+                # the timed-out compile may have wedged the tunnel (the
+                # batch-256 failure mode): without this re-probe every later
+                # candidate would burn its full timeout and be recorded as a
+                # flag failure it never earned (bench.run_bench does the same)
+                os.environ["XLA_FLAGS"] = base_flags
+                if not bench._probe_backend():
+                    truncated = True
+                    _write_md(rows, args, truncated)
+                    print(json.dumps({"error": "tunnel wedged mid-probe; "
+                                      "remaining candidates not tested"}))
+                    break
+    finally:
+        # an exception escaping the loop (e.g. _write_md IOError) must
+        # not leave a candidate's flags polluting the parent environment
+        os.environ["XLA_FLAGS"] = base_flags
 
 
 def _write_md(rows, args, truncated=False) -> None:

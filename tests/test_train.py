@@ -72,6 +72,69 @@ def test_checkpoint_resume_roundtrip(tiny_cfg, tmp_path):
     assert np.isfinite(r2.last_loss)
 
 
+def test_resume_survives_optimizer_structure_change(tmp_path):
+    """A checkpoint saved under an older optimizer tree (pre-masked-Adam)
+    must still resume: restore_latest falls back to weights-only restore
+    and reinitializes the optimizer instead of crashing on the Orbax
+    structure mismatch (an in-flight preempted run upgraded across the
+    optax.masked change would otherwise be stranded)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from milnce_tpu.config import OptimConfig
+    from milnce_tpu.models import S3D
+    from milnce_tpu.train.checkpoint import CheckpointManager
+    from milnce_tpu.train.schedule import build_schedule
+    from milnce_tpu.train.state import (TrainState, build_optimizer,
+                                        create_train_state)
+
+    model = S3D(num_classes=16, vocab_size=32, word_embedding_dim=8,
+                text_hidden_dim=16, inception_blocks=1)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, 4, 32, 32, 3), jnp.float32),
+                           jnp.zeros((4, 5), jnp.int32))
+    cfg = OptimConfig(name="adam", warmup_steps=2)
+    schedule = build_schedule(cfg, 10)
+
+    # the pre-change optimizer layout: plain Adam, no masked wrapper
+    old_opt = optax.inject_hyperparams(optax.adam)(learning_rate=schedule)
+    old_state = create_train_state(variables, old_opt)
+    old_state = old_state.replace(
+        step=jnp.asarray(7, jnp.int32),
+        params=jax.tree_util.tree_map(lambda x: x + 1.0, old_state.params))
+    mgr = CheckpointManager(str(tmp_path / "old_run"), keep=2)
+    mgr.save(3, old_state)
+    mgr.close()
+
+    new_opt = build_optimizer(cfg, schedule)       # masked layout
+    template = create_train_state(variables, new_opt)
+    mgr2 = CheckpointManager(str(tmp_path / "old_run"), keep=2, create=False)
+    epoch, restored = mgr2.restore_latest(template)
+    assert epoch == 3
+    assert int(restored.step) == 7
+    # weights came from the checkpoint (the +1.0 perturbation survived)...
+    old_leaf = jax.tree_util.tree_leaves(old_state.params)[0]
+    new_leaf = jax.tree_util.tree_leaves(restored.params)[0]
+    np.testing.assert_allclose(np.asarray(new_leaf), np.asarray(old_leaf))
+    # ...while the opt_state is the template's fresh masked structure
+    assert (jax.tree_util.tree_structure(restored.opt_state)
+            == jax.tree_util.tree_structure(template.opt_state))
+
+    # A *params* mismatch (model changed) must NOT be rescued — installing
+    # stale-shaped weights under a benign-sounding warning would defer the
+    # crash to a confusing optax error; the original exception re-raises.
+    other_model = S3D(num_classes=16, vocab_size=48, word_embedding_dim=8,
+                      text_hidden_dim=24, inception_blocks=2)
+    other_vars = other_model.init(jax.random.PRNGKey(1),
+                                  jnp.zeros((2, 4, 32, 32, 3), jnp.float32),
+                                  jnp.zeros((4, 5), jnp.int32))
+    bad_template = create_train_state(other_vars, new_opt)
+    mgr3 = CheckpointManager(str(tmp_path / "old_run"), keep=2, create=False)
+    with pytest.raises((ValueError, KeyError, TypeError)):
+        mgr3.restore_latest(bad_template)
+
+
 def _eval_csvs(tmp_path):
     import csv as csv_mod
 
