@@ -38,6 +38,9 @@ class DataConfig:
     num_reader_threads: int = 20        # host-side decode workers per process
     use_native_reader: bool = False     # C++ ReaderPool pipe pump for ffmpeg
                                         # decode (native/milnce_native.cpp)
+    decoder_backend: str = "auto"       # auto | ffmpeg | cv2 (auto prefers
+                                        # the ffmpeg binary, falls back to
+                                        # in-process cv2 decode)
     prefetch_depth: int = 2             # device prefetch buffer (batches)
     decode_lookahead: int = 2           # extra batches of decode futures kept
                                         # in flight across batch boundaries

@@ -35,7 +35,7 @@ import numpy as np
 from milnce_tpu.config import DataConfig, ModelConfig
 from milnce_tpu.data.captions import CaptionTrack, sample_caption
 from milnce_tpu.data.tokenizer import Tokenizer, synthetic_vocab
-from milnce_tpu.data.video import (ClipDecoder, FFmpegDecoder, eval_windows,
+from milnce_tpu.data.video import (ClipDecoder, build_decoder, eval_windows,
                                    sample_clip)
 
 
@@ -76,12 +76,9 @@ class HowTo100MSource:
         self.rows = read_csv(cfg.train_csv)
         assert self.rows and "video_path" in self.rows[0], cfg.train_csv
         if decoder is None:
-            if cfg.use_native_reader:
-                from milnce_tpu.data.video import NativeFFmpegDecoder
-
-                decoder = NativeFFmpegDecoder(workers=cfg.num_reader_threads)
-            else:
-                decoder = FFmpegDecoder()
+            decoder = build_decoder(cfg.decoder_backend,
+                                    use_native_reader=cfg.use_native_reader,
+                                    workers=cfg.num_reader_threads)
         self.decoder = decoder
         self.tokenizer = tokenizer or build_tokenizer(model_cfg, cfg.max_words)
         self._caption_cache: "OrderedDict[str, CaptionTrack]" = OrderedDict()
@@ -161,7 +158,7 @@ class YouCookSource:
         self.cfg = cfg
         self.tokenizer = tokenizer
         self.num_clip = num_clip
-        self.decoder = decoder or FFmpegDecoder()
+        self.decoder = decoder or build_decoder(cfg.decoder_backend)
         self.max_words = max_words
 
     def __len__(self) -> int:
@@ -197,7 +194,7 @@ class MSRVTTSource:
         self.cfg = cfg
         self.tokenizer = tokenizer
         self.num_clip = num_clip
-        self.decoder = decoder or FFmpegDecoder()
+        self.decoder = decoder or build_decoder(cfg.decoder_backend)
         self.max_words = max_words
 
     def __len__(self) -> int:
@@ -225,7 +222,7 @@ class HMDBSource:
         self.video_root = video_root
         self.cfg = cfg
         self.num_clip = num_clip
-        self.decoder = decoder or FFmpegDecoder()
+        self.decoder = decoder or build_decoder(cfg.decoder_backend)
         self.with_flip = with_flip
 
     def __len__(self) -> int:

@@ -486,6 +486,109 @@ def _softdtw_bwd_scan(r_ext: jax.Array, d_ext_skew: jax.Array, n: int,
     return e_skew
 
 
+def _bwd_kernel_chunked(r_ref, d_ref, e_ref, carry, *, n: int, m: int,
+                        gamma: float, bandwidth: int, chunk: int, bt: int,
+                        n_chunks: int):
+    """Streaming backward: grid (B/bt, n_chunks), the E-recurrence's
+    mirror of ``_fwd_kernel_chunked``.  The chunk axis index_map REVERSES
+    block order (the wavefront runs high diagonal -> low), and six carry
+    rows — E, R, D at diagonals q+1 and q+2 — thread across chunk
+    boundaries in VMEM scratch, so no block ever reads a neighbor
+    diagonal from another block.  The sequence-length ceiling is HBM,
+    like the forward; the reference's backward simply stops at 1024
+    (soft_dtw_cuda.py:79-112, 318-320).
+
+    Diagonal q lives at array row q; rows above n+m+2 are zero padding
+    whose E is masked to 0 (their q fails the j<=m validity test) and
+    whose r/d values only ever neighbor the overridden/masked top rows.
+    The q = n+m+2 corner seed (E=1 at i=N+1, soft_dtw_cuda.py:166-167)
+    is applied as a where-override, which keeps the loop body uniform
+    across real, seed, and padding rows."""
+    n2 = n + 2
+    c = pl.program_id(1)
+    i_buf = lax.broadcasted_iota(jnp.int32, (bt, n2), 1)
+    inv_gamma = 1.0 / gamma
+
+    @pl.when(c == 0)
+    def _init():
+        carry[...] = jnp.zeros((6, bt, n2), jnp.float32)
+
+    def shift_left(row):                            # row[i] -> row[i+1]
+        return jnp.concatenate(
+            [row[:, 1:], jnp.zeros((bt, 1), row.dtype)], axis=1)
+
+    def body(s, _):
+        t = chunk - 1 - s                           # top row of the block first
+        q = (n_chunks - 1 - c) * chunk + t          # diagonal index
+        e_q1, e_q2 = carry[0], carry[1]
+        r_q1, r_q2 = carry[2], carry[3]
+        d_q1, d_q2 = carry[4], carry[5]
+        r_q = r_ref[t]
+        d_q = d_ref[t]
+
+        a = jnp.exp((shift_left(r_q1) - r_q - shift_left(d_q1)) * inv_gamma)
+        b_ = jnp.exp((r_q1 - r_q - d_q1) * inv_gamma)
+        c_ = jnp.exp((shift_left(r_q2) - r_q - shift_left(d_q2)) * inv_gamma)
+        e_row = shift_left(e_q1) * a + e_q1 * b_ + shift_left(e_q2) * c_
+
+        j_buf = q - i_buf
+        valid = ((i_buf >= 1) & (i_buf <= n) & (j_buf >= 1) & (j_buf <= m)
+                 & (r_q > -BIG / 2))                # unreached cells -> 0
+        if bandwidth > 0:
+            valid &= jnp.abs(i_buf - j_buf) <= bandwidth
+        e_row = jnp.where(valid, e_row, 0.0)
+        e_row = jnp.where(q == n + m + 2,           # corner seed E[N+1,M+1]=1
+                          (i_buf == n + 1).astype(jnp.float32), e_row)
+        e_ref[t] = e_row
+        carry[1] = e_q1                             # next step's q+2
+        carry[0] = e_row                            # next step's q+1
+        carry[3] = r_q1
+        carry[2] = r_q
+        carry[5] = d_q1
+        carry[4] = d_q
+        return 0
+
+    lax.fori_loop(0, chunk, body, 0)
+
+
+def _run_backward_chunked(r_ext_skew: jax.Array, d_ext_skew: jax.Array,
+                          n: int, m: int, gamma: float, bandwidth: int,
+                          chunk: int | None = None) -> jax.Array:
+    """(B, N+M+3, N+2) extended skewed R and D -> skewed E table, any
+    length: diagonals stream from HBM in chunks, highest first."""
+    import math
+
+    bsz = r_ext_skew.shape[0]
+    bt = 8
+    n2 = n + 2
+    if chunk is None:
+        # three streams (r, d, e) share the block budget; floor 1 is legal
+        chunk = max(1, min(512, _CHUNK_VMEM_ELEMS // (bt * 3 * n2)))
+    n_rows = n + m + 3
+    n_chunks = math.ceil(n_rows / chunk)
+    pad_p = n_chunks * chunk - n_rows
+    r3 = jnp.pad(_pad_batch(r_ext_skew, bt),
+                 ((0, 0), (0, pad_p), (0, 0))).transpose(1, 0, 2)
+    d3 = jnp.pad(_pad_batch(d_ext_skew, bt),
+                 ((0, 0), (0, pad_p), (0, 0))).transpose(1, 0, 2)
+    bp = r3.shape[1]
+    kernel = functools.partial(_bwd_kernel_chunked, n=n, m=m, gamma=gamma,
+                               bandwidth=bandwidth, chunk=chunk, bt=bt,
+                               n_chunks=n_chunks)
+    spec = pl.BlockSpec((chunk, bt, n2), lambda b, c: (n_chunks - 1 - c, b, 0))
+    out = pl.pallas_call(
+        kernel,
+        grid=(bp // bt, n_chunks),
+        in_specs=[spec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((n_chunks * chunk, bp, n2),
+                                       jnp.float32),
+        scratch_shapes=[pltpu.VMEM((6, bt, n2), jnp.float32)],
+        interpret=_interpret(),
+    )(r3, d3)
+    return out.transpose(1, 0, 2)[:bsz, :n_rows]
+
+
 # --------------------------------------------------------------- backward
 def _bwd_kernel(r_ref, d_ref, e_ref, *, n: int, m: int, gamma: float,
                 bandwidth: int, bt: int):
@@ -608,9 +711,14 @@ def _softdtw_pallas_bwd(gamma, bandwidth, residuals, grad_out):
     elif _table_fits_vmem(n, m):
         e_skew = _run_backward(r_ext, d_ext_skew, n, m, float(gamma),
                                int(bandwidth))
-    else:
+    elif os.environ.get("MILNCE_SDTW_BWD_SCAN") == "1":
+        # debugging escape hatch / cross-implementation golden
         e_skew = _softdtw_bwd_scan(r_ext, d_ext_skew, n, m, float(gamma),
                                    int(bandwidth))
+    else:
+        # long-sequence path: stream diagonals from HBM, highest first
+        e_skew = _run_backward_chunked(r_ext, d_ext_skew, n, m,
+                                       float(gamma), int(bandwidth))
     # grad_D[i, j] = g * E[i+1, j+1]  (skewed: diag i+j+2, idx i+1)
     i_idx = jnp.arange(n)[:, None]
     j_idx = jnp.arange(m)[None, :]

@@ -78,6 +78,15 @@ class CheckpointManager:
             if (jax.tree_util.tree_structure(raw["params"])
                     != jax.tree_util.tree_structure(template.params)):
                 raise
+            stored_shapes = jax.tree_util.tree_map(
+                lambda x: (tuple(x.shape), jnp.dtype(x.dtype).name),
+                raw["params"])
+            template_shapes = jax.tree_util.tree_map(
+                lambda x: (tuple(x.shape), jnp.dtype(x.dtype).name),
+                template.params)
+            if stored_shapes != template_shapes:
+                raise                   # same tree, resized leaves (e.g. a
+                                        # grown vocab) — also not rescuable
             logging.getLogger(__name__).warning(
                 "checkpoint %d has an incompatible optimizer-state "
                 "structure (%s); restored weights only and reinitialized "

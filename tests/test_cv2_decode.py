@@ -1,0 +1,203 @@
+"""Real video decode via the in-process cv2 backend.
+
+These tests exercise the PRODUCTION decode path on actual encoded mp4
+bytes — the first in the suite to do so (the ffmpeg-binary path stays
+argv-parity-tested only, no binary in this environment; cv2 links the
+same libav* libraries directly).  Videos are written with
+cv2.VideoWriter (mpeg4): each frame is a constant uint8 value equal to
+4x its index, so frame *identity* survives lossy encode within a small
+tolerance and seek/fps-resample selection is checkable frame by frame.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from milnce_tpu.config import tiny_preset
+from milnce_tpu.data.tokenizer import Tokenizer
+from milnce_tpu.data.video import Cv2Decoder, build_decoder
+
+cv2 = pytest.importorskip("cv2")
+
+SRC_FPS = 20
+W, H = 96, 64
+N_FRAMES = 120                      # 6 s at 20 fps
+
+
+def _frame_value(i: int) -> int:
+    return (i * 4) % 250
+
+
+def _write_video(path, w=W, h=H, n=N_FRAMES, fps=SRC_FPS):
+    vw = cv2.VideoWriter(str(path), cv2.VideoWriter_fourcc(*"mp4v"),
+                         float(fps), (w, h))
+    assert vw.isOpened()
+    for i in range(n):
+        vw.write(np.full((h, w, 3), _frame_value(i), np.uint8))
+    vw.release()
+
+
+@pytest.fixture(scope="module")
+def video_path(tmp_path_factory):
+    p = tmp_path_factory.mktemp("vids") / "clip.mp4"
+    _write_video(p)
+    return str(p)
+
+
+def _values(frames):
+    """Median pixel value per frame — robust to mpeg4 ringing."""
+    return np.median(frames.reshape(frames.shape[0], -1), axis=1)
+
+
+class TestCv2Decoder:
+    def test_duration(self, video_path):
+        dec = Cv2Decoder()
+        assert dec.duration(video_path) == pytest.approx(
+            N_FRAMES / SRC_FPS, rel=0.02)
+
+    def test_fps_downsample_selects_expected_frames(self, video_path):
+        """Target 5 fps over a 20 fps source: output k maps to source
+        frame 4k (the last source frame with pts <= k/5)."""
+        dec = Cv2Decoder()
+        out = dec.decode(video_path, 0.0, 2.0, fps=5, size=48)
+        assert out.shape[1:] == (48, 48, 3) and out.dtype == np.uint8
+        vals = _values(out)
+        expect = [_frame_value(4 * k) for k in range(len(vals))]
+        np.testing.assert_allclose(vals, expect, atol=12)
+
+    def test_fps_upsample_duplicates(self, video_path):
+        """Target 40 fps over a 20 fps source: each source frame appears
+        twice."""
+        dec = Cv2Decoder()
+        out = dec.decode(video_path, 0.0, 0.5, fps=40, size=32)
+        vals = _values(out)
+        expect = [_frame_value(k // 2) for k in range(len(vals))]
+        np.testing.assert_allclose(vals, expect, atol=12)
+
+    def test_seek_starts_at_requested_second(self, video_path):
+        dec = Cv2Decoder()
+        out = dec.decode(video_path, 3.0, 1.0, fps=SRC_FPS, size=32)
+        vals = _values(out)
+        # first output frame = source frame at 3.0 s = index 60
+        assert abs(vals[0] - _frame_value(60)) <= 12
+
+    def test_eof_stops_instead_of_duplicating(self, video_path):
+        """Request far past the end: output stops at the last source
+        frame's span (ffmpeg -t semantics); the caller pads."""
+        dec = Cv2Decoder()
+        out = dec.decode(video_path, 5.0, 10.0, fps=10, size=32)
+        assert out.shape[0] <= 12       # ~1 s of source remains
+
+    def test_crop_only_offsets(self, tmp_path):
+        """Spatial gradient source: fractional offsets select the
+        expected window (ffmpeg crop=(iw-size)*aw parity)."""
+        p = tmp_path / "grad.mp4"
+        vw = cv2.VideoWriter(str(p), cv2.VideoWriter_fourcc(*"mp4v"),
+                             10.0, (96, 64))
+        col = np.linspace(0, 240, 96, dtype=np.uint8)
+        frame = np.repeat(col[None, :, None], 64, axis=0)
+        frame = np.repeat(frame, 3, axis=2)
+        for _ in range(20):
+            vw.write(frame)
+        vw.release()
+        dec = Cv2Decoder()
+        left = dec.decode(str(p), 0.0, 0.5, fps=10, size=32, aw=0.0, ah=0.5,
+                          crop_only=True)
+        right = dec.decode(str(p), 0.0, 0.5, fps=10, size=32, aw=1.0, ah=0.5,
+                           crop_only=True)
+        # gradient increases left->right: the aw=1 crop is brighter
+        assert right.mean() > left.mean() + 50
+
+    def test_square_crop_and_scale(self, video_path):
+        dec = Cv2Decoder()
+        out = dec.decode(video_path, 0.0, 0.5, fps=10, size=40,
+                         crop_only=False)
+        assert out.shape[1:] == (40, 40, 3)
+
+    def test_hflip(self, tmp_path):
+        p = tmp_path / "flip.mp4"
+        vw = cv2.VideoWriter(str(p), cv2.VideoWriter_fourcc(*"mp4v"),
+                             10.0, (64, 64))
+        frame = np.zeros((64, 64, 3), np.uint8)
+        frame[:, :32] = 200             # bright LEFT half
+        for _ in range(10):
+            vw.write(frame)
+        vw.release()
+        dec = Cv2Decoder()
+        plain = dec.decode(str(p), 0.0, 0.3, fps=10, size=64, aw=0.5,
+                           ah=0.5, crop_only=True, hflip=False)
+        flip = dec.decode(str(p), 0.0, 0.3, fps=10, size=64, aw=0.5,
+                          ah=0.5, crop_only=True, hflip=True)
+        assert plain[0, :, :32].mean() > plain[0, :, 32:].mean() + 100
+        assert flip[0, :, 32:].mean() > flip[0, :, :32].mean() + 100
+
+    def test_missing_file_raises(self):
+        with pytest.raises(RuntimeError):
+            Cv2Decoder().decode("/nonexistent/x.mp4", 0.0, 1.0, 10, 32)
+
+    def test_crop_only_rejects_small_frames(self, video_path):
+        """ffmpeg's crop filter fails frames smaller than the crop; the
+        cv2 backend must too (same decode-failure resampling on both)."""
+        with pytest.raises(RuntimeError, match="smaller than crop"):
+            Cv2Decoder().decode(video_path, 0.0, 0.5, fps=10, size=128,
+                                crop_only=True)
+
+
+def test_build_decoder_auto_falls_back_to_cv2(monkeypatch):
+    """No ffmpeg binary on this host -> auto resolves to cv2."""
+    import milnce_tpu.data.video as video_mod
+
+    monkeypatch.setattr(video_mod.shutil, "which", lambda _: None)
+    assert isinstance(build_decoder("auto"), Cv2Decoder)
+
+
+def test_build_decoder_rejects_unknown():
+    with pytest.raises(ValueError):
+        build_decoder("quicktime")
+
+
+def test_build_decoder_cv2_warns_on_native_reader():
+    with pytest.warns(UserWarning, match="native"):
+        dec = build_decoder("cv2", use_native_reader=True)
+    assert isinstance(dec, Cv2Decoder)
+
+
+def test_howto_source_end_to_end_on_real_videos(tmp_path):
+    """The full production train path on actual encoded bytes: manifest
+    csv -> caption sampling -> cv2 decode -> (T, H, W, 3) uint8 clips,
+    through HowTo100MSource with NO fake decoder."""
+    from milnce_tpu.data.datasets import HowTo100MSource
+
+    (tmp_path / "videos").mkdir()
+    (tmp_path / "captions").mkdir()
+    rows = ["video_path"]
+    for i in range(2):
+        _write_video(tmp_path / "videos" / f"vid{i}.mp4")
+        rows.append(f"vid{i}.mp4")
+        caps = {"start": [0.0, 2.0], "end": [2.0, 4.0],
+                "text": ["word1 word2", "word3 word4"]}
+        (tmp_path / "captions" / f"vid{i}.json").write_text(json.dumps(caps))
+    (tmp_path / "train.csv").write_text("\n".join(rows))
+
+    cfg = tiny_preset()
+    cfg.data.train_csv = str(tmp_path / "train.csv")
+    cfg.data.video_root = str(tmp_path / "videos")
+    cfg.data.caption_root = str(tmp_path / "captions")
+    cfg.data.decoder_backend = "cv2"
+    cfg.data.num_candidates = 2
+    cfg.data.num_frames = 8
+    cfg.data.fps = 5
+    cfg.data.video_size = 32
+    cfg.data.crop_only = False          # sources are 96x64 < 224
+    tok = Tokenizer([f"word{i}" for i in range(1, 5)], cfg.data.max_words)
+    src = HowTo100MSource(cfg.data, cfg.model, tokenizer=tok)
+    assert isinstance(src.decoder, Cv2Decoder)
+    rng = np.random.RandomState(0)
+    for idx in range(2):
+        s = src.sample(idx, rng)
+        assert s["video"].shape == (8, 32, 32, 3)
+        assert s["video"].dtype == np.uint8
+        assert s["video"].max() > 0     # real decoded content, not padding
+        assert s["text"].shape == (2, cfg.data.max_words)
+    assert src.decode_failures == 0
