@@ -268,7 +268,20 @@ def build_decoder(backend: str = "auto", use_native_reader: bool = False,
     and falls back to in-process cv2 when no binary is installed."""
     requested = backend
     if backend == "auto":
-        backend = "ffmpeg" if FFmpegDecoder().available() else "cv2"
+        # an explicit native-reader request implies the ffmpeg pipe-pump
+        # path: honor it rather than silently resolving to cv2 — but fail
+        # HERE if the binary is missing.  A decoder whose every decode
+        # raises would be swallowed by the source's per-sample resampling
+        # (black-frame fallback) and the run would silently train on
+        # garbage frames.
+        if use_native_reader and not FFmpegDecoder().available():
+            raise RuntimeError(
+                "use_native_reader needs the ffmpeg binary (the C++ "
+                "ReaderPool pumps ffmpeg subprocess pipes) but none is on "
+                "PATH — install ffmpeg, or drop use_native_reader to let "
+                "'auto' fall back to in-process cv2 decode")
+        backend = ("ffmpeg" if use_native_reader or FFmpegDecoder().available()
+                   else "cv2")
     if backend == "ffmpeg":
         if use_native_reader:
             return NativeFFmpegDecoder(workers=workers)

@@ -45,7 +45,6 @@ def _sequence_loss(loss_cfg, v_seq, t_seq, start, data_axis):
     v_all = lax.all_gather(v_seq, data_axis, axis=0, tiled=True)
     t_all = lax.all_gather(t_seq, data_axis, axis=0, tiled=True)
     start_all = lax.all_gather(start, data_axis, axis=0, tiled=True)
-    name = loss_cfg.name
     common = dict(backend=getattr(loss_cfg, "sdtw_backend", "scan"),
                   dist=getattr(loss_cfg, "sdtw_dist", ""),
                   bandwidth=getattr(loss_cfg, "sdtw_bandwidth", 0))
@@ -53,17 +52,33 @@ def _sequence_loss(loss_cfg, v_seq, t_seq, start, data_axis):
         # None = each loss function's own reference-default gamma
         # (cdtw 1e-5, sdtw_* 0.1 — encoded in their signatures)
         common["gamma"] = loss_cfg.sdtw_gamma
-    if name == "cdtw":
-        return cdtw_batch_loss(v_all, t_all, **common)
-    if name == "sdtw_cidm":
-        return sdtw_cidm_loss(v_all, t_all, start_all,
-                              sigma=loss_cfg.cidm_sigma,
-                              lam=loss_cfg.cidm_lambda, **common)
-    if name == "sdtw_negative":
-        return sdtw_negative_loss(v_all, t_all, **common)
-    if name == "sdtw_3":
-        return sum(sdtw_3_loss(v_all, t_all, **common))
-    raise ValueError(f"unknown loss {name!r}")
+    dispatch = {
+        "cdtw": lambda: cdtw_batch_loss(v_all, t_all, **common),
+        "sdtw_cidm": lambda: sdtw_cidm_loss(
+            v_all, t_all, start_all, sigma=loss_cfg.cidm_sigma,
+            lam=loss_cfg.cidm_lambda, **common),
+        "sdtw_negative": lambda: sdtw_negative_loss(v_all, t_all, **common),
+        "sdtw_3": lambda: sum(sdtw_3_loss(v_all, t_all, **common)),
+    }
+    # one source of truth: a loss added here without a KNOWN_LOSSES entry
+    # (or vice versa) fails loudly at first trace, not per-name
+    assert set(dispatch) == set(KNOWN_LOSSES) - {"milnce"}, (
+        "sequence-loss dispatch and KNOWN_LOSSES diverged")
+    return dispatch[loss_cfg.name]()
+
+
+KNOWN_LOSSES = ("milnce", "cdtw", "sdtw_cidm", "sdtw_negative", "sdtw_3")
+
+
+def _check_loss_name(loss_cfg) -> str:
+    """Reject a bad loss name at step-BUILD time: inside the traced step
+    the error would only surface after a full model trace (and on a real
+    cluster, after an expensive XLA compile)."""
+    name = getattr(loss_cfg, "name", "milnce")
+    if name not in KNOWN_LOSSES:
+        raise ValueError(f"unknown loss {name!r} (expected one of "
+                         f"{', '.join(KNOWN_LOSSES)})")
+    return name
 
 
 def make_grad_cache_step(model, optimizer, mesh: Mesh,
@@ -102,7 +117,7 @@ def make_grad_cache_step(model, optimizer, mesh: Mesh,
     already accumulates a mesh-size factor into the embedding grads).
     """
     assert micro_batches > 1, "use make_train_step for micro_batches=1"
-    loss_name = getattr(loss_cfg, "name", "milnce")
+    loss_name = _check_loss_name(loss_cfg)
     compute_dtype = jnp.dtype(getattr(model, "dtype", jnp.float32))
 
     def local_step(state: TrainState, video_u8, text_ids, start):
@@ -205,7 +220,7 @@ def make_train_step(model, optimizer, mesh: Mesh, data_axis: str = "data",
     only: it amortizes per-dispatch host latency (a remote-tunnel execute
     costs seconds) so the measurement reflects device throughput.
     """
-    loss_name = getattr(loss_cfg, "name", "milnce")
+    loss_name = _check_loss_name(loss_cfg)
     # normalize straight into the model's compute dtype: a bf16 model casts
     # the video to bf16 at conv1 anyway (Conv3D promote_dtype), so an f32
     # intermediate would only add HBM traffic on the largest activation

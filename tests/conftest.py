@@ -19,5 +19,5 @@ jax.config.update("jax_default_matmul_precision", "highest")
 # Persistent compilation cache: the S3D train step takes ~2 min to compile
 # on the virtual 8-device CPU mesh; identical HLO across test runs hits disk.
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

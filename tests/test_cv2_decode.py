@@ -201,3 +201,14 @@ def test_howto_source_end_to_end_on_real_videos(tmp_path):
         assert s["video"].max() > 0     # real decoded content, not padding
         assert s["text"].shape == (2, cfg.data.max_words)
     assert src.decode_failures == 0
+
+
+def test_build_decoder_native_requires_binary(monkeypatch):
+    """auto + use_native_reader with no ffmpeg binary must fail at BUILD
+    time: a decoder whose every decode raises would be swallowed by the
+    source's black-frame resampling and the run would train on garbage."""
+    import milnce_tpu.data.video as video_mod
+
+    monkeypatch.setattr(video_mod.shutil, "which", lambda _: None)
+    with pytest.raises(RuntimeError, match="ReaderPool"):
+        build_decoder("auto", use_native_reader=True)

@@ -4,6 +4,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from milnce_tpu.ops.dtw import dtw_loss, dtw_path, dtw_table
 
@@ -47,6 +48,7 @@ def test_path_always_marks_corners():
     assert (path[:, -1, -1] == 1).all()
 
 
+@pytest.mark.slow
 def test_loss_runs_and_differentiates():
     rng = np.random.RandomState(2)
     x = jnp.asarray(rng.randn(2, 6, 8).astype(np.float32))

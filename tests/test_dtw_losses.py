@@ -4,6 +4,7 @@ with the hardcoded shapes removed per SURVEY.md §1)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from milnce_tpu.losses.dtw_losses import (cdtw_loss, sdtw_3_loss,
                                           sdtw_cidm_loss, sdtw_negative_loss)
@@ -65,6 +66,7 @@ def test_sdtw_negative_matches_numpy_formula():
     np.testing.assert_allclose(got, expected, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_sdtw3_three_terms_and_gradients():
     v, t = _seqs(b=3, n=4, m=4, seed=9)
     l1, l2, l3 = sdtw_3_loss(v, t, gamma=0.1)
@@ -90,6 +92,7 @@ def test_dist_and_bandwidth_knobs_reach_the_dp():
     assert float(l3[1]) != float(l3_override[1])
 
 
+@pytest.mark.slow
 def test_sequence_loss_threads_config_knobs():
     """The train-step dispatcher forwards dist/bandwidth from LossConfig."""
     from jax.sharding import Mesh
@@ -116,6 +119,7 @@ def test_sequence_loss_threads_config_knobs():
     assert base != banded and base != distd
 
 
+@pytest.mark.slow
 def test_sequence_loss_per_loss_gamma_defaults():
     """sdtw_gamma=None resolves to each loss's reference default: 1e-5
     for cdtw (loss.py:26), 0.1 for the sdtw_* family (loss.py:38,74,97);

@@ -71,6 +71,7 @@ def tiny_model_vars():
     return model, variables
 
 
+@pytest.mark.slow
 def test_retrieval_eval_pipeline(tiny_model_vars):
     import jax
     from jax.sharding import Mesh
@@ -104,6 +105,7 @@ class _ProbeSource:
                 "splits": np.array([1 if idx < 6 else 2] * 3, np.int32)}
 
 
+@pytest.mark.slow
 def test_linear_probe_pipeline(tiny_model_vars):
     import jax
     from jax.sharding import Mesh

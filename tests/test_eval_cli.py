@@ -60,6 +60,7 @@ def _cli_args(task, csv_path, ckpt):
     return args
 
 
+@pytest.mark.slow
 def test_youcook_cli_smoke(ckpt_dir, tmp_path):
     from milnce_tpu.eval.cli import main
 
@@ -82,6 +83,7 @@ def test_msrvtt_cli_smoke(ckpt_dir, tmp_path):
     assert set(metrics) == {"R1", "R5", "R10", "MR"}
 
 
+@pytest.mark.slow
 def test_hmdb_cli_smoke(ckpt_dir, tmp_path):
     from milnce_tpu.eval.cli import main
 

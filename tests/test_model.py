@@ -47,6 +47,7 @@ def test_text_only_mode(model_and_vars):
     assert out.shape == (3, 32)
 
 
+@pytest.mark.slow
 def test_train_mode_updates_batch_stats(model_and_vars):
     model, variables = model_and_vars
     video = jnp.ones((2, 4, 32, 32, 3), jnp.float32)
@@ -69,6 +70,7 @@ def test_gating_flag_actually_disables_gating():
     assert not any("gating" in n for n in names)
 
 
+@pytest.mark.slow
 def test_text_embedding_gradient_is_zero(model_and_vars):
     """word2vec table is frozen via stop_gradient (s3dg.py:199-200)."""
     model, variables = model_and_vars
@@ -96,6 +98,7 @@ def test_space_to_depth_layout():
     np.testing.assert_allclose(y[0, 0, 0, 0, 23], x[0, 1, 1, 1, 2])
 
 
+@pytest.mark.slow
 def test_space_to_depth_model_shapes():
     m = tiny_model(use_space_to_depth=True)
     video = jnp.zeros((1, 8, 64, 64, 3), jnp.float32)
@@ -204,6 +207,7 @@ class TestConv3DFold2D:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.slow
     def test_full_model_parity(self):
         """Whole S3D-G forward agrees across conv impls on the same
         variables (the param trees are layout-identical by design)."""

@@ -80,14 +80,20 @@ class TestNativeFFmpegDecoder:
         with pytest.raises(RuntimeError, match="no frames"):
             dec.decode("corrupt.mp4", 0.0, 2.0, 2, 8)
 
-    def test_howto_source_native_flag(self, tmp_path):
+    def test_howto_source_native_flag(self, tmp_path, monkeypatch):
         """DataConfig.use_native_reader routes the source's default decoder
         through the C++ pool (VERDICT r1 weak #5 / next #6)."""
         import json
 
+        import milnce_tpu.data.video as video_mod
         from milnce_tpu.config import tiny_preset
         from milnce_tpu.data.datasets import HowTo100MSource
         from milnce_tpu.data.video import NativeFFmpegDecoder
+
+        # no real ffmpeg on this host: satisfy the build-time availability
+        # gate (the decode itself is routed to a stub binary below)
+        monkeypatch.setattr(video_mod.shutil, "which",
+                            lambda _: "/usr/bin/ffmpeg")
 
         (tmp_path / "captions").mkdir()
         (tmp_path / "captions" / "vid0.json").write_text(json.dumps(

@@ -15,8 +15,11 @@ import pytest
 REFERENCE = "/root/reference"
 
 torch = pytest.importorskip("torch")
-pytestmark = pytest.mark.skipif(not os.path.isdir(REFERENCE),
-                                reason="reference checkout not available")
+pytestmark = [
+    pytest.mark.slow,           # builds the real torch S3D (~1 min)
+    pytest.mark.skipif(not os.path.isdir(REFERENCE),
+                       reason="reference checkout not available"),
+]
 
 
 @pytest.fixture(scope="module")

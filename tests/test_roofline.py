@@ -9,6 +9,7 @@ analytic number is expected a little UNDER XLA's — pinned to a band.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from milnce_tpu.utils.roofline import (roofline_table, s3d_video_stages,
                                        text_fwd_flops, train_step_flops,
@@ -22,6 +23,7 @@ def _xla_flops(fn, *args):
     return float(cost["flops"])
 
 
+@pytest.mark.slow
 def test_video_fwd_tracks_xla():
     from milnce_tpu.models import S3D
 
@@ -40,6 +42,7 @@ def test_video_fwd_tracks_xla():
     assert 0.75 * got <= want <= 1.05 * got, (want, got)
 
 
+@pytest.mark.slow
 def test_video_fwd_tracks_xla_s2d():
     from milnce_tpu.models import S3D
 
@@ -72,6 +75,7 @@ def test_text_fwd_tracks_xla():
     assert 0.7 * got <= want <= 1.1 * got, (want, got)
 
 
+@pytest.mark.slow
 def test_train_step_tracks_xla():
     """The bench fallback path: full train-step estimate (3x fwd + logits)
     vs XLA's count of the real sharded step program."""
@@ -123,6 +127,7 @@ def test_roofline_table_renders():
     assert "HBM" in c2b_row
 
 
+@pytest.mark.slow
 def test_stage_shapes_match_model():
     """The stage list's final shape must equal the real trunk output."""
     from milnce_tpu.models import S3D

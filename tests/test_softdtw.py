@@ -142,6 +142,7 @@ def test_no_length_cap():
     assert np.isfinite(float(out[0]))
 
 
+@pytest.mark.slow
 def test_auto_backend_dispatch(monkeypatch):
     """backend='auto' picks the kernel wherever a measured-winning layout
     applies (one-block sublane-batch, or batch-on-lanes at any batch) and

@@ -10,7 +10,11 @@ from milnce_tpu.ops import softdtw_pallas as sp
 from milnce_tpu.ops.softdtw import skew_cost, softdtw_scan
 
 
-@pytest.mark.parametrize("n,m,chunk", [(6, 6, 4), (9, 5, 3), (5, 12, 8)])
+@pytest.mark.parametrize("n,m,chunk", [
+    (6, 6, 4),
+    pytest.param(9, 5, 3, marks=pytest.mark.slow),
+    pytest.param(5, 12, 8, marks=pytest.mark.slow),
+])
 def test_chunked_forward_matches_scan(n, m, chunk):
     rng = np.random.RandomState(0)
     D = jnp.asarray(rng.rand(2, n, m).astype(np.float32))
@@ -25,6 +29,7 @@ def test_chunked_forward_matches_scan(n, m, chunk):
                                rtol=1e-5, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_scan_backward_matches_pallas_backward():
     rng = np.random.RandomState(1)
     n = m = 7
@@ -41,7 +46,11 @@ def test_scan_backward_matches_pallas_backward():
                                rtol=1e-4, atol=1e-5)
 
 
-@pytest.mark.parametrize("n,m", [(7, 7), (9, 5), (5, 12)])
+@pytest.mark.parametrize("n,m", [
+    (7, 7),
+    pytest.param(9, 5, marks=pytest.mark.slow),
+    pytest.param(5, 12, marks=pytest.mark.slow),
+])
 def test_chunked_backward_matches_scan_backward(n, m):
     """The HBM-streaming backward kernel (reverse-ordered chunks + six
     carry rows) must produce the scan backward's gradients exactly."""
@@ -58,6 +67,7 @@ def test_chunked_backward_matches_scan_backward(n, m):
                                np.asarray(grad_ref), rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_chunked_backward_with_bandwidth():
     rng = np.random.RandomState(5)
     D = jnp.asarray(rng.rand(2, 16, 16).astype(np.float32))
@@ -73,6 +83,7 @@ def test_chunked_backward_with_bandwidth():
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_genuinely_long_backward_chunked_vs_scan(monkeypatch):
     """A shape that routes to the chunked kernel through the REAL
     dispatch (no budget monkeypatching): (200, 180) tables are ~7x the

@@ -12,7 +12,12 @@ from milnce_tpu.ops.softdtw import SoftDTW, softdtw_scan
 from milnce_tpu.ops.softdtw_pallas import softdtw_pallas
 
 
-@pytest.mark.parametrize("n,m", [(4, 4), (7, 5), (3, 9), (16, 16)])
+@pytest.mark.parametrize("n,m", [
+    (4, 4),
+    pytest.param(7, 5, marks=pytest.mark.slow),
+    pytest.param(3, 9, marks=pytest.mark.slow),
+    (16, 16),
+])
 def test_forward_matches_scan(n, m):
     rng = np.random.RandomState(0)
     D = jnp.asarray(rng.rand(3, n, m).astype(np.float32))
@@ -39,6 +44,7 @@ def test_bandwidth_matches_scan():
     np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_gradient_with_upstream_cotangent():
     rng = np.random.RandomState(3)
     D = jnp.asarray(rng.rand(3, 5, 5).astype(np.float32))
@@ -65,6 +71,7 @@ def test_rectangular_extreme():
                                np.asarray(softdtw_scan(D, 1.0)), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_batch_tiling_pads_and_slices():
     """Batches above the 128-element tile cap split into multiple padded
     blocks (fwd AND bwd); values/grads must match the scan exactly."""
@@ -79,6 +86,7 @@ def test_batch_tiling_pads_and_slices():
                                rtol=1e-3, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_profile_harness_smoke():
     """The timing+allclose harness (the reference's only self-check,
     soft_dtw_cuda.py:389-463) runs end-to-end and reports agreement."""
@@ -90,6 +98,7 @@ def test_profile_harness_smoke():
     assert rec["scan_fwd_ms"] >= 0.0 and rec["pallas_fwd_ms"] >= 0.0
 
 
+@pytest.mark.slow
 def test_mil_regime_batch_squared_pairs():
     """The SDTW_3 training regime: B^2 short pairs (32x32 alignment, the
     shape that crashed Mosaic's vector lowering before the batch-tile
@@ -105,6 +114,7 @@ def test_mil_regime_batch_squared_pairs():
                                rtol=1e-3, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_lanes_layout_matches_scan(monkeypatch):
     """Large-batch short-pair shapes route through the batch-on-lanes
     kernels by default (measured 3.5-26x on v5e, BENCH_SOFTDTW.md);

@@ -21,6 +21,7 @@ def tiny_cfg(tmp_path_factory):
     return cfg
 
 
+@pytest.mark.slow
 def test_training_runs_and_loss_is_finite(tiny_cfg):
     from milnce_tpu.train.loop import run_training
 
@@ -29,6 +30,7 @@ def test_training_runs_and_loss_is_finite(tiny_cfg):
     assert np.isfinite(result.last_loss)
 
 
+@pytest.mark.slow
 def test_no_per_step_host_sync(tiny_cfg, tmp_path, monkeypatch):
     """The hot loop must not block the host on every step (VERDICT r1 #7):
     loss transfers happen only at display points / exit, via
@@ -54,6 +56,7 @@ def test_no_per_step_host_sync(tiny_cfg, tmp_path, monkeypatch):
     assert calls["n"] <= 3, f"host synced {calls['n']} times in 4 steps"
 
 
+@pytest.mark.slow
 def test_checkpoint_resume_roundtrip(tiny_cfg, tmp_path):
     import jax
 
@@ -72,6 +75,7 @@ def test_checkpoint_resume_roundtrip(tiny_cfg, tmp_path):
     assert np.isfinite(r2.last_loss)
 
 
+@pytest.mark.slow
 def test_resume_survives_optimizer_structure_change(tmp_path):
     """A checkpoint saved under an older optimizer tree (pre-masked-Adam)
     must still resume: restore_latest falls back to weights-only restore
@@ -155,6 +159,7 @@ def _eval_csvs(tmp_path):
     return str(yc), str(hm)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("task", ["youcook", "hmdb"])
 def test_in_training_eval_runs(tiny_cfg, tmp_path, task, capsys):
     """The reference's in-training evaluator is dead code
@@ -328,6 +333,7 @@ def test_loader_epoch_reshuffles():
     assert not np.array_equal(e0["video"], e1["video"])
 
 
+@pytest.mark.slow
 def test_loss_decreases_when_overfitting_one_batch():
     """End-to-end learning sanity: repeated steps on ONE fixed batch must
     reduce the MIL-NCE loss — gradients flow through conv towers, text
@@ -370,6 +376,7 @@ def test_loss_decreases_when_overfitting_one_batch():
     assert all(np.isfinite(l) for l in losses), losses
 
 
+@pytest.mark.slow
 def test_train_step_on_two_axis_mesh():
     """SURVEY §2.3: TP isn't needed for S3D, but the mesh must be READY
     for a model axis — the identical train step has to compile and match
@@ -419,6 +426,7 @@ def test_train_step_on_two_axis_mesh():
                                rtol=1e-5)
 
 
+@pytest.mark.slow
 class TestGradCache:
     """Two-pass embedding-cache contrastive step (train/step.py
     make_grad_cache_step), for MIL-NCE and the DTW family: M microbatches
@@ -555,6 +563,7 @@ class TestGradCache:
         assert np.isfinite(result.last_loss)
 
 
+@pytest.mark.slow
 def test_mid_epoch_resume_skips_consumed_batches(tiny_cfg, tmp_path):
     """Preemption mid-epoch must not retrain consumed batches: a 4-step
     epoch stopped at step 3 resumes with exactly 1 batch left."""
@@ -575,6 +584,7 @@ def test_mid_epoch_resume_skips_consumed_batches(tiny_cfg, tmp_path):
     assert int(second.state.step) == 4
 
 
+@pytest.mark.slow
 def test_boundary_stop_resumes_as_epoch_complete(tiny_cfg, tmp_path):
     """A stop landing exactly on the epoch's last batch must label the
     checkpoint epoch+1: resuming with epochs=1 has nothing left to run

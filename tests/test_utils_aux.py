@@ -120,6 +120,7 @@ class TestMultihostDetect:
         assert len(called) == 1
 
 
+@pytest.mark.slow
 class TestNaNGuard:
     def test_halts_and_checkpoints_on_nan(self, tmp_path):
         """A synthetic source whose batches drive the loss to NaN must
