@@ -815,6 +815,33 @@ def main():
             return last, status
 
         probe_info = _probe_backend()
+        # Wait-for-heal: the tunnel wedges for stretches of tens of
+        # minutes (observed 2026-07-29..31) and a round's bench gate that
+        # happens to land inside one records a CPU fallback even though
+        # the chip is fine (BENCH_r02.json).  Re-probe on a cadence within
+        # MILNCE_BENCH_WAIT_HEAL — and charge BOTH the sleeps and the
+        # probes against it, then deduct the whole wait from the TPU
+        # child's budget below, so the worst-case time-to-JSON-record is
+        # NO LONGER than before this feature existed (an outer gate tuned
+        # to the old worst case must never kill us record-less mid-wait).
+        heal_spent = 0.0
+        if probe_info is None:
+            heal_budget = float(os.environ.get("MILNCE_BENCH_WAIT_HEAL",
+                                               "1800"))
+            heal_start = time.time()
+            while probe_info is None:
+                remaining = heal_budget - (time.time() - heal_start)
+                if remaining <= 0:
+                    break
+                wait_s = min(300.0, remaining)
+                _note(f"bench: waiting {wait_s:.0f}s for the tunnel to heal "
+                      f"({remaining / 60:.0f} min of budget left)")
+                time.sleep(wait_s)
+                remaining = heal_budget - (time.time() - heal_start)
+                if remaining <= 0:
+                    break
+                probe_info = _probe_backend(timeout_s=min(180.0, remaining))
+            heal_spent = time.time() - heal_start
         if probe_info:
             # Even a healthy-probing tunnel can wedge mid-sweep; bound the
             # whole TPU run and fall back rather than hang the gate.  A
@@ -826,6 +853,10 @@ def main():
             # wedge the tunnel for LATER clients, so prefer setting
             # MILNCE_BENCH_TPU_TIMEOUT below any outer deadline.
             budget = float(os.environ.get("MILNCE_BENCH_TPU_TIMEOUT", "4500"))
+            # a late heal ate into the overall time box: hand the sweep
+            # what's left (it streams interim records and marks partial,
+            # so a truncated sweep still lands its rows)
+            budget = max(300.0, budget - heal_spent)
             rec, status = run_child("tpu", timeout=budget,
                                     device_info=probe_info)
             if rec is not None:

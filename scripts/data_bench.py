@@ -1,0 +1,167 @@
+"""Host decode feed-rate benchmark: can the input pipeline keep a chip fed?
+
+Measures the PRODUCTION decode path (cv2 in-process backend, or the
+ffmpeg subprocess path when a binary exists) on real encoded video at
+training clip shapes, and reports clips/s per host thread plus the
+thread count needed to sustain the measured chip demand
+(BENCH_NOTES.md: 392.95 clips/s/chip at bf16 batch 128, 16f@224^2).
+
+The reference feeds its pods with 40 ffmpeg reader threads per worker
+(README.md:56); this script produces the equivalent sizing number for
+our host pipeline.
+
+    python scripts/data_bench.py                  # writes DATA_BENCH.md
+    python scripts/data_bench.py --clips 64 --threads 1 2 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+CHIP_DEMAND = 392.95          # clips/s/chip, BENCH_NOTES.md operating point
+
+
+def _write_source_video(path: str, w: int, h: int, seconds: float,
+                        fps: int) -> None:
+    """Realistic-ish mpeg4 source: moving gradient so inter-frame motion
+    gives the codec real work (a static scene decodes unrealistically
+    fast)."""
+    import cv2
+
+    vw = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"),
+                         float(fps), (w, h))
+    assert vw.isOpened(), "cv2.VideoWriter failed to open"
+    base = np.add.outer(np.arange(h), np.arange(w)) % 256
+    for i in range(int(seconds * fps)):
+        frame = ((base + 7 * i) % 256).astype(np.uint8)
+        vw.write(np.stack([frame, np.roll(frame, i, 0),
+                           np.roll(frame, -i, 1)], axis=2))
+    vw.release()
+
+
+def _measure(decoder, paths, n_clips: int, threads: int, num_frames: int,
+             fps: int, size: int, crop_only: bool) -> dict:
+    """Decode ``n_clips`` random training clips over ``threads`` workers;
+    returns wall-clock clips/s (whole pool) and per-thread rate."""
+    from milnce_tpu.data.video import sample_clip
+
+    rngs = [np.random.RandomState(1000 + t) for t in range(threads)]
+
+    def one(i):
+        rng = rngs[i % threads]
+        path = paths[i % len(paths)]
+        clip = sample_clip(decoder, path, 0.0, 28.0, num_frames, fps, size,
+                           rng, crop_only, False, True)
+        assert clip.shape == (num_frames, size, size, 3)
+        return clip.nbytes
+
+    with ThreadPoolExecutor(max_workers=threads) as pool:
+        list(pool.map(one, range(min(threads * 2, n_clips))))  # warm up
+        t0 = time.perf_counter()
+        total = sum(pool.map(one, range(n_clips)))
+        dt = time.perf_counter() - t0
+    return {"threads": threads, "clips_per_sec": n_clips / dt,
+            "clips_per_sec_per_thread": n_clips / dt / threads,
+            "mb_per_sec": total / dt / 1e6, "wall_s": dt}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clips", type=int, default=48)
+    ap.add_argument("--threads", type=int, nargs="+", default=[1, 2, 4])
+    ap.add_argument("--num_frames", type=int, default=16)
+    ap.add_argument("--fps", type=int, default=10)
+    ap.add_argument("--size", type=int, default=224)
+    ap.add_argument("--src", default="320x240",
+                    help="source resolution WxH (240p is HowTo100M-like)")
+    ap.add_argument("--seconds", type=float, default=30.0)
+    ap.add_argument("--no_md", action="store_true")
+    args = ap.parse_args()
+    w, h = (int(x) for x in args.src.split("x"))
+
+    from milnce_tpu.data.video import build_decoder
+
+    tmp = tempfile.mkdtemp(prefix="data_bench_")
+    paths = []
+    for i in range(4):
+        p = os.path.join(tmp, f"src{i}.mp4")
+        _write_source_video(p, w, h, args.seconds, 30)
+        paths.append(p)
+    src_mb = sum(os.path.getsize(p) for p in paths) / 1e6
+
+    decoder = build_decoder("auto")
+    backend = type(decoder).__name__
+    # crop_only needs a source >= crop size; 240p is smaller than 224^2
+    # in one dimension only when h < size
+    crop_only = w >= args.size and h >= args.size
+
+    rows = []
+    for t in args.threads:
+        r = _measure(decoder, paths, args.clips, t, args.num_frames,
+                     args.fps, args.size, crop_only)
+        r["backend"] = backend
+        print(json.dumps(r), flush=True)
+        rows.append(r)
+
+    best = max(rows, key=lambda r: r["clips_per_sec"])
+    per_thread = max(r["clips_per_sec_per_thread"] for r in rows)
+    need = int(np.ceil(CHIP_DEMAND / per_thread))
+    summary = {"backend": backend, "source": f"{w}x{h} mpeg4",
+               "clip": f"{args.num_frames}f@{args.size}^2 fps{args.fps}",
+               "best_clips_per_sec": round(best["clips_per_sec"], 2),
+               "per_thread_clips_per_sec": round(per_thread, 2),
+               "threads_for_chip_demand": need,
+               "chip_demand": CHIP_DEMAND}
+    print(json.dumps(summary), flush=True)
+
+    if not args.no_md:
+        lines = [
+            "# Host decode feed rate (auto-written by scripts/data_bench.py)",
+            "",
+            f"- decode backend: **{backend}** (production path; no fakes)",
+            f"- source: {w}x{h} mpeg4, {args.seconds:.0f}s, 30fps, "
+            f"{src_mb / 4:.1f} MB/video ({4 * src_mb / (4 * args.seconds):.2f}"
+            " MB/s bitrate)",
+            f"- clip: {args.num_frames} frames @ {args.size}^2, "
+            f"fps={args.fps}, random seek/crop/flip (sample_clip, the "
+            "training draw)",
+            f"- host: {os.cpu_count()} CPU core(s) visible",
+            "",
+            "| threads | clips/s (pool) | clips/s/thread | MB/s decoded |",
+            "|---|---|---|---|",
+        ]
+        for r in rows:
+            lines.append(f"| {r['threads']} | {r['clips_per_sec']:.2f} | "
+                         f"{r['clips_per_sec_per_thread']:.2f} | "
+                         f"{r['mb_per_sec']:.1f} |")
+        lines += [
+            "",
+            f"**Sizing**: at {per_thread:.2f} clips/s/thread, sustaining the "
+            f"measured chip demand of {CHIP_DEMAND} clips/s/chip "
+            f"(BENCH_NOTES.md bf16 b128 operating point) needs "
+            f"**~{need} reader threads per chip** — the reference provisions "
+            "40 ffmpeg threads per worker for its v3-32 pods "
+            "(README.md:56).",
+            "",
+            "Caveats: single-core measurement host (thread rows mostly "
+            "show GIL/`cv2` release behavior, not real scaling); mpeg4 "
+            "(HowTo100M is largely h264 — cv2 decodes both through "
+            "libavcodec, rates within the same order).",
+        ]
+        with open(os.path.join(_REPO, "DATA_BENCH.md"), "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+
+
+if __name__ == "__main__":
+    main()

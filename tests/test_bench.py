@@ -100,6 +100,55 @@ def test_wedge_truncation_marks_partial(monkeypatch):
     assert recs, "interim record for the measured row was never streamed"
 
 
+def test_main_waits_for_tunnel_heal(monkeypatch):
+    """A failed initial probe must not immediately mean CPU fallback:
+    main re-probes within the MILNCE_BENCH_WAIT_HEAL budget and runs the
+    TPU child once the tunnel answers (VERDICT r2: BENCH_r02.json was a
+    CPU fallback recorded during a heal-able wedge)."""
+    probes = {"n": 0}
+
+    def flaky_probe(*a, **k):
+        probes["n"] += 1
+        if probes["n"] < 3:
+            return None                  # wedged...
+        return {"platform": "tpu", "kind": "TPU v5 lite", "n": 1}
+
+    sleeps = []
+    monkeypatch.setenv("MILNCE_BENCH_WAIT_HEAL", "700")
+    monkeypatch.setattr(bench, "_probe_backend", flaky_probe)
+    monkeypatch.setattr(bench.time, "sleep", sleeps.append)
+
+    # intercept the child launch: record which platform main chose
+    import subprocess as sp
+    launched = {}
+
+    class FakeProc:
+        returncode = 0
+        stdout = None
+
+        def wait(self, timeout=None):
+            return 0
+
+    def fake_popen(cmd, **kw):
+        launched["env_child"] = kw.get("env", {}).get("MILNCE_BENCH_CHILD_MODE")
+        p = FakeProc()
+        import io
+        p.stdout = io.BytesIO(
+            b'{"metric": "train_step clips/sec/chip", "value": 1.0, '
+            b'"unit": "clips/sec/chip", "vs_baseline": 0.01, '
+            b'"_bench_record": true}\n')
+        return p
+
+    monkeypatch.setattr(bench.subprocess, "Popen", fake_popen)
+    recs = []
+    monkeypatch.setattr(bench, "_emit", recs.append)
+    bench.main()
+    assert probes["n"] == 3              # healed on the third probe
+    assert len(sleeps) == 2              # slept between failed probes
+    assert launched["env_child"] == "tpu"
+    assert recs and recs[-1]["value"] == 1.0
+
+
 def test_peak_flops_lookup():
     assert bench._peak_flops("TPU v5 lite") == 197e12
     assert bench._peak_flops("TPU v4") == 275e12
