@@ -51,19 +51,25 @@ def _write_source_video(path: str, w: int, h: int, seconds: float,
 
 
 def _measure(decoder, paths, n_clips: int, threads: int, num_frames: int,
-             fps: int, size: int, crop_only: bool) -> dict:
+             fps: int, size: int, crop_only: bool,
+             source_seconds: float) -> dict:
     """Decode ``n_clips`` random training clips over ``threads`` workers;
     returns wall-clock clips/s (whole pool) and per-thread rate."""
     from milnce_tpu.data.video import sample_clip
 
     rngs = [np.random.RandomState(1000 + t) for t in range(threads)]
+    clip_sec = num_frames / float(fps)
+    # keep every random seek inside the source so each draw decodes real
+    # frames (a seek past EOF would zero-pad and inflate the rate)
+    end = max(clip_sec, source_seconds - clip_sec - 0.5)
 
     def one(i):
         rng = rngs[i % threads]
         path = paths[i % len(paths)]
-        clip = sample_clip(decoder, path, 0.0, 28.0, num_frames, fps, size,
+        clip = sample_clip(decoder, path, 0.0, end, num_frames, fps, size,
                            rng, crop_only, False, True)
         assert clip.shape == (num_frames, size, size, 3)
+        assert clip.any(), "decoded clip is all zeros — seek past EOF?"
         return clip.nbytes
 
     with ThreadPoolExecutor(max_workers=threads) as pool:
@@ -92,27 +98,32 @@ def main() -> None:
 
     from milnce_tpu.data.video import build_decoder
 
+    import shutil
+
     tmp = tempfile.mkdtemp(prefix="data_bench_")
-    paths = []
-    for i in range(4):
-        p = os.path.join(tmp, f"src{i}.mp4")
-        _write_source_video(p, w, h, args.seconds, 30)
-        paths.append(p)
-    src_mb = sum(os.path.getsize(p) for p in paths) / 1e6
+    try:
+        paths = []
+        for i in range(4):
+            p = os.path.join(tmp, f"src{i}.mp4")
+            _write_source_video(p, w, h, args.seconds, 30)
+            paths.append(p)
+        src_mb = sum(os.path.getsize(p) for p in paths) / 1e6
 
-    decoder = build_decoder("auto")
-    backend = type(decoder).__name__
-    # crop_only needs a source >= crop size; 240p is smaller than 224^2
-    # in one dimension only when h < size
-    crop_only = w >= args.size and h >= args.size
+        decoder = build_decoder("auto")
+        backend = type(decoder).__name__
+        # crop_only needs a source >= crop size; 240p is smaller than
+        # 224^2 in one dimension only when h < size
+        crop_only = w >= args.size and h >= args.size
 
-    rows = []
-    for t in args.threads:
-        r = _measure(decoder, paths, args.clips, t, args.num_frames,
-                     args.fps, args.size, crop_only)
-        r["backend"] = backend
-        print(json.dumps(r), flush=True)
-        rows.append(r)
+        rows = []
+        for t in args.threads:
+            r = _measure(decoder, paths, args.clips, t, args.num_frames,
+                         args.fps, args.size, crop_only, args.seconds)
+            r["backend"] = backend
+            print(json.dumps(r), flush=True)
+            rows.append(r)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
     best = max(rows, key=lambda r: r["clips_per_sec"])
     per_thread = max(r["clips_per_sec_per_thread"] for r in rows)
@@ -131,8 +142,8 @@ def main() -> None:
             "",
             f"- decode backend: **{backend}** (production path; no fakes)",
             f"- source: {w}x{h} mpeg4, {args.seconds:.0f}s, 30fps, "
-            f"{src_mb / 4:.1f} MB/video ({4 * src_mb / (4 * args.seconds):.2f}"
-            " MB/s bitrate)",
+            f"{src_mb / 4:.1f} MB/video "
+            f"({src_mb / 4 / args.seconds:.2f} MB/s bitrate)",
             f"- clip: {args.num_frames} frames @ {args.size}^2, "
             f"fps={args.fps}, random seek/crop/flip (sample_clip, the "
             "training draw)",
