@@ -201,6 +201,7 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
                   words: int, k: int, remat: bool,
                   inner: int = 1, s2d: bool = False,
                   conv_impl: str = "native", loss: str = "milnce",
+                  grad_accum: int = 1,
                   peak: float | None = None, flops_hint: float | None = None):
     """Time the full train step at one operating point.
 
@@ -237,8 +238,20 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
         cfg.loss.sdtw_backend = "auto"   # Pallas where the measured
         loss_cfg = cfg.loss              # crossover says it wins
     optimizer = build_optimizer(cfg.optim, build_schedule(cfg.optim, 1000))
-    step_fn = make_train_step(model, optimizer, mesh, donate=False,
-                              inner_steps=inner, loss_cfg=loss_cfg)
+    if grad_accum > 1:
+        # the two-pass embedding-cache program (the 8192-global-batch
+        # recipe's step): ``batch`` clips consumed per update via
+        # grad_accum microbatches.  No inner-step scan — one dispatch IS
+        # already grad_accum sub-steps of work, which amortizes tunnel
+        # latency the same way.
+        assert inner == 1, "grad_accum rows measure with inner=1"
+        from milnce_tpu.train.step import make_grad_cache_step
+
+        step_fn = make_grad_cache_step(model, optimizer, mesh, grad_accum,
+                                       donate=False, loss_cfg=loss_cfg)
+    else:
+        step_fn = make_train_step(model, optimizer, mesh, donate=False,
+                                  inner_steps=inner, loss_cfg=loss_cfg)
 
     # Everything below runs ON DEVICE in three jitted programs.  The
     # obvious host-side version (eager model.init + optimizer.init +
@@ -270,9 +283,11 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
         make_inputs, out_shardings=(data_sh, data_sh, data_sh))(
             jax.random.PRNGKey(1))
 
-    if loss != "milnce":
-        # neither the hint nor the analytic model counts the alignment
-        # DP; report raw throughput without an MFU for DTW rows
+    if loss != "milnce" or grad_accum > 1:
+        # DTW rows: neither the hint nor the analytic model counts the
+        # alignment DP.  grad_accum rows: the two-pass step does ~2x the
+        # forward FLOPs of the plain step, so the plain-model MFU would
+        # be fiction.  Report raw throughput only.
         flops, flops_source = None, None
     elif flops_hint is not None:
         # Seeded from an earlier XLA-counted config of the same plan (see
@@ -341,20 +356,35 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
         dt = (w2 - w1) / (k2 - k1)         # per-dispatch device time
 
     n_chips = len(jax.devices())
-    if flops:
+    guard_flops = flops
+    if guard_flops is None:
+        # DTW / grad_accum rows report no FLOPs, but the plausibility
+        # guard below must still hold: the PLAIN step's analytic FLOPs
+        # are a strict lower bound on the true work per clip for both
+        # (the DP / the second embedding pass only add work), so a
+        # tunnel fantasy reading still trips the bound.
+        from milnce_tpu.utils.roofline import train_step_flops
+
+        guard_flops = train_step_flops(
+            batch, frames, size, k, words, space_to_depth=s2d,
+            inception_blocks=cfg.model.inception_blocks,
+            embedding_dim=cfg.model.embedding_dim,
+            word_dim=cfg.model.word_embedding_dim,
+            hidden=cfg.model.text_hidden_dim)
+    if guard_flops:
         # Physical sanity: implied FLOP/s beyond this device's peak means
         # the measurement is broken (e.g. a tunnel whose block_until_ready
         # resolves early — observed 2026-07-30 producing 392k clips/s/chip,
         # 4000x reality).  Better no row than a fantasy row.  flops counts
         # the whole sharded step, so scale the bound by chip count; the
         # fleet-wide max is the fallback when the device kind is unknown.
-        implied = flops * inner / dt
+        implied = guard_flops * inner / dt
         bound = 1.5 * (peak or max(_PEAK_FLOPS.values())) * n_chips
         if implied > bound:
             raise RuntimeError(
                 f"implausible measurement: {implied:.3e} FLOP/s implied "
-                f"(dt={dt:.6f}s for {inner} steps of {flops:.3e} FLOPs "
-                f"on {n_chips} chips, bound {bound:.3e})")
+                f"(dt={dt:.6f}s for {inner} steps of >={guard_flops:.3e} "
+                f"FLOPs on {n_chips} chips, bound {bound:.3e})")
     result = {
         "dtype": dtype,
         "batch": batch,
@@ -362,6 +392,7 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
         "s2d": s2d,
         "conv_impl": conv_impl,
         "loss": loss,
+        "grad_accum": grad_accum,
         "inner": inner,
         "step_ms": round(dt / inner * 1e3, 2),
         "clips_per_sec_per_chip": round(batch * inner / dt / n_chips, 3),
@@ -542,13 +573,17 @@ def run_bench(on_tpu: bool, info: dict):
         linear = f0 - milnce_logits_flops(b0, k)
         return linear * batch / b0 + milnce_logits_flops(batch, k)
 
-    def measure(dtype, batch, remat, s2d, conv_impl, loss="milnce"):
+    def measure(dtype, batch, remat, s2d, conv_impl, loss="milnce",
+                grad_accum=1, timeout_s=None):
         return _run_config(
-            timeout_s=cfg_timeout, platform_pin=None if on_tpu else "cpu",
+            timeout_s=timeout_s or cfg_timeout,
+            platform_pin=None if on_tpu else "cpu",
             dtype=dtype, batch=batch, frames=frames,
-            size=size, words=words, k=k, remat=remat, inner=inner, s2d=s2d,
-            conv_impl=conv_impl, loss=loss, peak=peak,
-            flops_hint=hint(dtype, remat, s2d, batch))
+            size=size, words=words, k=k, remat=remat,
+            inner=1 if grad_accum > 1 else inner, s2d=s2d,
+            conv_impl=conv_impl, loss=loss, grad_accum=grad_accum, peak=peak,
+            flops_hint=None if grad_accum > 1
+            else hint(dtype, remat, s2d, batch))
 
     def tunnel_wedged(exc) -> bool:
         """A config timeout on TPU may mean the whole tunnel is wedged
@@ -642,7 +677,8 @@ def run_bench(on_tpu: bool, info: dict):
             # comparison rows with a different loss are slower by design
             # (more work per clip) and must not displace the headline
             best = max((x for x in results
-                        if x.get("loss", "milnce") == "milnce"),
+                        if x.get("loss", "milnce") == "milnce"
+                        and x.get("grad_accum", 1) == 1),
                        key=lambda x: x["clips_per_sec_per_chip"])
             _emit(_make_record(best, frames, size, on_tpu, kind))
         except Exception as exc:
@@ -668,6 +704,16 @@ def run_bench(on_tpu: bool, info: dict):
     # just in the kernel microbench (opt out: MILNCE_BENCH_SDTW=0).
     if on_tpu and os.environ.get("MILNCE_BENCH_SDTW") != "0":
         extra_row("sdtw_3", loss="sdtw_3", s2d=False, conv_impl="native")
+    # North-star recipe row: the per-chip slice of the 8192-global-batch
+    # training step — 8 embedding-cache microbatches of the winning batch
+    # in ONE update (BASELINE.md HMDB-53.1 recipe; memory- and
+    # equivalence-proven in tests, measured here).  Bigger compile + 8x
+    # the work per dispatch -> double timeout (opt out:
+    # MILNCE_BENCH_GRAD_ACCUM=0).
+    if on_tpu and os.environ.get("MILNCE_BENCH_GRAD_ACCUM") != "0":
+        extra_row("grad_accum8", batch=8 * best["batch"], grad_accum=8,
+                  s2d=False, conv_impl="native",
+                  timeout_s=2 * cfg_timeout)
 
     _write_notes(results, best, kind, on_tpu, n_devices,
                  truncated=dead)
@@ -694,13 +740,14 @@ def _write_notes(results, best, kind, on_tpu, n_chips, truncated=False):
                  f"- chosen operating point: dtype={best['dtype']} "
                  f"batch={best['batch']} remat={best['remat']} -> "
                  f"{best['clips_per_sec_per_chip']} clips/sec/chip",
-                 "", "| dtype | batch | remat | s2d | conv | loss | step_ms | clips/s/chip | MFU |",
-                 "|---|---|---|---|---|---|---|---|---|"]
+                 "", "| dtype | batch | remat | s2d | conv | loss | ga | step_ms | clips/s/chip | MFU |",
+                 "|---|---|---|---|---|---|---|---|---|---|"]
         for r in results:
             lines.append(f"| {r['dtype']} | {r['batch']} | {r['remat']} | "
                          f"{r.get('s2d', False)} | "
                          f"{r.get('conv_impl', 'native')} | "
                          f"{r.get('loss', 'milnce')} | "
+                         f"{r.get('grad_accum', 1)} | "
                          f"{r['step_ms']} | {r['clips_per_sec_per_chip']} | "
                          f"{r.get('mfu', '-')} |")
         if truncated:
@@ -828,6 +875,17 @@ def main():
         if probe_info is None:
             heal_budget = float(os.environ.get("MILNCE_BENCH_WAIT_HEAL",
                                                "1800"))
+            # Placeholder record BEFORE the wait: if an outer gate kills
+            # this parent mid-sleep, the consumer (last parsable line)
+            # still gets an honest marker instead of no JSON at all.
+            # Any real measurement emitted later supersedes it.
+            _emit({"metric": "train_step clips/sec/chip", "value": 0.0,
+                   "unit": "clips/sec/chip", "vs_baseline": 0.0,
+                   "on_tpu": False,
+                   "note": "tunnel down at probe time; waiting up to "
+                           f"{heal_budget:.0f}s for heal — if this is the "
+                           "final line, the process was killed mid-wait",
+                   "last_tpu_value": LAST_TPU_OPERATING_POINT})
             heal_start = time.time()
             while probe_info is None:
                 remaining = heal_budget - (time.time() - heal_start)
@@ -846,13 +904,14 @@ def main():
             # Even a healthy-probing tunnel can wedge mid-sweep; bound the
             # whole TPU run and fall back rather than hang the gate.  A
             # full sweep with two cold compiles and one wedged-config cap
-            # is ~65 min, so the default budget must clear ~3900s.
+            # was ~65 min (~3900s); the grad_accum8 row adds up to
+            # 2*cfg_timeout more, so the default clears ~5700s.
             # Interim records stream to stdout as they land, so if an
             # OUTER timeout kills this parent first no measurement is
             # lost — but the kill skips _graceful_stop and can still
             # wedge the tunnel for LATER clients, so prefer setting
             # MILNCE_BENCH_TPU_TIMEOUT below any outer deadline.
-            budget = float(os.environ.get("MILNCE_BENCH_TPU_TIMEOUT", "4500"))
+            budget = float(os.environ.get("MILNCE_BENCH_TPU_TIMEOUT", "6300"))
             # a late heal ate into the overall time box: hand the sweep
             # what's left (it streams interim records and marks partial,
             # so a truncated sweep still lands its rows)

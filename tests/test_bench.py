@@ -194,6 +194,21 @@ class TestConfigChild:
         assert r["clips_per_sec_per_chip"] > 0
         json.dumps(r)
 
+    def test_grad_accum_row_measures_embedding_cache_step(self):
+        # the north-star recipe row: grad_accum>1 routes the measurement
+        # through make_grad_cache_step; FLOPs/MFU are suppressed (the
+        # plain-step model doesn't describe the two-pass program) and the
+        # record carries the grad_accum tag for BENCH_NOTES
+        r = bench._run_config(timeout_s=600, platform_pin="cpu",
+                              dtype="float32", batch=16, frames=4, size=32,
+                              words=4, k=2, remat=False, inner=1, s2d=False,
+                              conv_impl="native", grad_accum=2, peak=None,
+                              flops_hint=None)
+        assert r["grad_accum"] == 2
+        assert r["flops_per_step"] is None and "mfu" not in r
+        assert r["clips_per_sec_per_chip"] > 0
+        json.dumps(r)
+
     def test_run_config_timeout_is_tagged(self):
         # a child that cannot finish inside the watchdog raises the
         # 'config timeout' marker the sweep's wedge detection keys on
