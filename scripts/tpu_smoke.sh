@@ -19,7 +19,7 @@ timeout 240 python -c "import jax, jax.numpy as jnp; print(float(jax.jit(lambda:
   || { echo "accelerator unreachable — aborting (bench.py alone would fall back to CPU)"; exit 1; }
 
 echo "=== bench ==="
-MILNCE_BENCH_TPU_TIMEOUT="${MILNCE_BENCH_TPU_TIMEOUT:-4500}" python bench.py
+MILNCE_BENCH_TPU_TIMEOUT="${MILNCE_BENCH_TPU_TIMEOUT:-6300}" python bench.py
 
 echo "=== re-probe (the tunnel can wedge DURING bench: observed 2026-07-30,"
 echo "    remote_compile port refused connections 33 min after a healthy probe) ==="

@@ -27,6 +27,9 @@ for i in $(seq 1 60); do
     echo "=== stage probe (fold2d) ==="
     python scripts/stage_probe.py --batch 64 --dtype bfloat16 --conv_impl fold2d \
       && cp STAGE_PROBE.md STAGE_PROBE_fold2d.md
+    echo "=== soft-DTW kernel profile (reference presets; exercises the"
+    echo "    new chunked HBM-streaming backward at the long presets) ==="
+    python -m milnce_tpu.ops.softdtw_profile | tee SOFTDTW_PROFILE_r03.jsonl
     echo "=== measurement queue done ($(date -u +%H:%M)) ==="
     exit 0
   fi
