@@ -1,35 +1,56 @@
 #!/bin/bash
-# Wait for the TPU tunnel to heal, then run the whole measurement queue
-# once: tpu_smoke.sh (bench sweep + train-loop cross-check), then the
-# per-stage probe for both conv lowerings.
+# Wait for the TPU tunnel to heal, then run the measurement queue once:
+# per-stage probe, XLA flag probe, tpu_smoke.sh (bench sweep +
+# train-loop cross-check), fold2d stage probe, soft-DTW preset profile.
 #
 #   nohup bash scripts/tpu_watch.sh > /tmp/tpu_watch.log 2>&1 &
 #
 # Probes are bounded subprocess executes (the bench.py _probe_backend
 # recipe) spaced 10 min apart — a wedged relay has been observed to heal
 # on the scale of hours.
+#
+# MILNCE_WATCH_DEADLINE (epoch seconds, default now+6h) bounds BOTH the
+# probing and the queue: near a round boundary the driver runs its own
+# bench client, and a second concurrent tunnel client is a known wedge
+# mode — better to stop clean than to contend.  After the deadline only
+# the currently-running queue step finishes; remaining steps are skipped
+# with a note.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
+DEADLINE="${MILNCE_WATCH_DEADLINE:-$(( $(date +%s) + 6*3600 ))}"
+
+past_deadline() { [ "$(date +%s)" -ge "$DEADLINE" ]; }
+
+step() {  # step <name> <cmd...>
+  local name="$1"; shift
+  if past_deadline; then
+    echo "=== SKIPPED $name: past deadline ($(date -u +%H:%M)) — leaving the tunnel to the round driver ==="
+    return 0
+  fi
+  echo "=== $name ($(date -u +%H:%M)) ==="
+  "$@"
+}
+
 for i in $(seq 1 60); do
+  if past_deadline; then
+    echo "deadline reached while probing ($(date -u +%H:%M)) — exiting clean"
+    exit 0
+  fi
   if timeout 240 python -c "import jax, jax.numpy as jnp; print(float(jax.jit(lambda: jnp.ones(4).sum())()))" >/dev/null 2>&1; then
     echo "=== tunnel healthy (probe $i, $(date -u +%H:%M)) — running measurement queue ==="
     # Unique diagnostics FIRST: if the tunnel heals late in a round,
     # only the head of this queue completes — and the round driver
     # re-runs bench.py itself at round end, so the sweep goes last-ish.
-    echo "=== stage probe (native) ==="
-    python scripts/stage_probe.py --batch 64 --dtype bfloat16 --conv_impl native \
-      && cp STAGE_PROBE.md STAGE_PROBE_native.md
-    echo "=== XLA flag probe at the winning operating point ==="
-    python scripts/xla_flag_probe.py --batch 128
-    echo "=== bench sweep + train cross-check ==="
-    bash scripts/tpu_smoke.sh
-    echo "=== stage probe (fold2d) ==="
-    python scripts/stage_probe.py --batch 64 --dtype bfloat16 --conv_impl fold2d \
-      && cp STAGE_PROBE.md STAGE_PROBE_fold2d.md
-    echo "=== soft-DTW kernel profile (reference presets; exercises the"
-    echo "    new chunked HBM-streaming backward at the long presets) ==="
-    python -m milnce_tpu.ops.softdtw_profile | tee SOFTDTW_PROFILE_r03.jsonl
+    step "stage probe (native)" bash -c \
+      "python scripts/stage_probe.py --batch 64 --dtype bfloat16 --conv_impl native && cp STAGE_PROBE.md STAGE_PROBE_native.md"
+    step "XLA flag probe at the winning operating point" \
+      python scripts/xla_flag_probe.py --batch 128
+    step "bench sweep + train cross-check" bash scripts/tpu_smoke.sh
+    step "stage probe (fold2d)" bash -c \
+      "python scripts/stage_probe.py --batch 64 --dtype bfloat16 --conv_impl fold2d && cp STAGE_PROBE.md STAGE_PROBE_fold2d.md"
+    step "soft-DTW profile (reference presets; chunked bwd at the long ones)" bash -c \
+      "python -m milnce_tpu.ops.softdtw_profile | tee SOFTDTW_PROFILE_r03.jsonl"
     echo "=== measurement queue done ($(date -u +%H:%M)) ==="
     exit 0
   fi
