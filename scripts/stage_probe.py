@@ -78,6 +78,13 @@ def main() -> None:
                     choices=["native", "fold2d"])
     ap.add_argument("--iters", type=int, default=8,
                     help="chained executions per measurement")
+    ap.add_argument("--mode", default="fwd", choices=["fwd", "fwdbwd"],
+                    help="fwdbwd also differentiates each stage w.r.t. "
+                         "its params AND input — the training cost.  The "
+                         "backward is ~2/3 of a train step's FLOPs and "
+                         "grad-conv lowerings tile differently from the "
+                         "forward, so a stage at its forward roofline can "
+                         "still be the step's MFU sink")
     args = ap.parse_args()
 
     if os.environ.get("MILNCE_PROFILE_CPU") == "1":
@@ -126,10 +133,26 @@ def main() -> None:
     compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
 
     def stage_apply(method):
-        def fn(x):
+        def fwd(x):
             return model.apply(variables, x, method=method)
 
-        return fn
+        if args.mode == "fwd":
+            return fwd, fwd
+
+        def fwdbwd(x):
+            # grads w.r.t. params AND input — what training pays at this
+            # stage.  Both grads fold into one scalar so neither is DCE'd.
+            def loss(v, xx):
+                return jnp.sum(model.apply(v, xx, method=method)
+                               .astype(jnp.float32))
+
+            dv, dx = jax.grad(loss, argnums=(0, 1))(variables, x)
+            acc = jnp.sum(dx.astype(jnp.float32))
+            for leaf in jax.tree_util.tree_leaves(dv):
+                acc = acc + jnp.sum(leaf.astype(jnp.float32))
+            return acc
+
+        return fwd, fwdbwd
 
     block_names = [n for n, _ in roofline.INCEPTION_PLAN]
 
@@ -139,15 +162,23 @@ def main() -> None:
 
         return stage_apply(method)
 
-    # (stage name, fn, pool applied to the input first)
+    # (stage name, (fwd, probe) fns, pool applied to the input first)
+    def pool_stage(window, strides):
+        def fwd(x):
+            return _tf_same_max_pool(x, window, strides)
+
+        if args.mode == "fwd":
+            return fwd, fwd
+        return fwd, jax.grad(lambda x: jnp.sum(fwd(x).astype(jnp.float32)))
+
     stages = [
         ("conv1", stage_apply(lambda m, x: m.conv1(x, False)), None),
-        ("maxpool_2a", lambda x: _tf_same_max_pool(x, (1, 3, 3), (1, 2, 2)),
+        ("maxpool_2a", pool_stage((1, 3, 3), (1, 2, 2)),
          None),
         ("conv_2b", stage_apply(lambda m, x: m.conv_2b(x, False)), None),
         ("conv_2c", stage_apply(lambda m, x: m.conv_2c(x, False)), None),
         ("gating", stage_apply(lambda m, x: m.stem_gating(x)), None),
-        ("maxpool_3a", lambda x: _tf_same_max_pool(x, (1, 3, 3), (1, 2, 2)),
+        ("maxpool_3a", pool_stage((1, 3, 3), (1, 2, 2)),
          None),
     ]
     for idx, name in enumerate(block_names):
@@ -180,15 +211,17 @@ def main() -> None:
 
     records = []
     total_ms = 0.0
-    for name, fn, pool in stages:
+    for name, (fwd_fn, probe_fn), pool in stages:
         if pool is not None:
             x = _tf_same_max_pool(x, *pool)
-        t = _timed(fn, x, args.iters)
-        flops = flops_by_prefix.get(name, 0.0)
-        byts = bytes_by_prefix.get(name, 0.0)
+        t = _timed(probe_fn, x, args.iters)
+        mult = 3.0 if args.mode == "fwdbwd" else 1.0
+        flops = mult * flops_by_prefix.get(name, 0.0)
+        byts = mult * bytes_by_prefix.get(name, 0.0)
         bound_s = max(flops / peak_flops, byts / hbm_gbs) if byts else None
         rec = {
             "stage": name,
+            "mode": args.mode,
             "in_shape": list(x.shape),
             "ms": round(t * 1e3, 3),
             "gflop": round(flops / 1e9, 2),
@@ -206,13 +239,13 @@ def main() -> None:
             # stages already measured
             _write_md(records, args)
         total_ms += t * 1e3
-        x = jax.jit(fn)(x)              # advance to the next stage's input
+        x = jax.jit(fwd_fn)(x)          # advance via the FORWARD output
 
     # whole-trunk forward for reconciliation (sum of parts vs one program:
     # the difference is what XLA's cross-stage fusion buys)
-    trunk = stage_apply(lambda m, v: m.forward_video(v))
+    trunk_fwd, _ = stage_apply(lambda m, v: m.forward_video(v))
     x0 = device_input(1)
-    t_trunk = _timed(trunk, x0, args.iters)
+    t_trunk = _timed(trunk_fwd, x0, args.iters)
     summary = {
         "stage": "TRUNK_FWD(one program)",
         "ms": round(t_trunk * 1e3, 3),
@@ -234,7 +267,9 @@ def _write_md(records, args) -> None:
     lines = [
         "# Stage probe (auto-written by scripts/stage_probe.py)", "",
         f"- config: batch={args.batch} {args.frames}f@{args.size}^2 "
-        f"dtype={args.dtype} conv_impl={args.conv_impl}",
+        f"dtype={args.dtype} conv_impl={args.conv_impl} mode={args.mode}"
+        + (" (per-stage fwd+bwd incl. param grads; roofline bound x3)"
+           if args.mode == "fwdbwd" else ""),
         "- ms = chained-scan differenced host-materialized time; "
         "roofline_ms = max(FLOPs/peak, bytes/HBM) analytic bound; "
         "x_over = measured/bound (1.0 = at the roofline).", "",

@@ -42,8 +42,10 @@ for i in $(seq 1 60); do
     # Unique diagnostics FIRST: if the tunnel heals late in a round,
     # only the head of this queue completes — and the round driver
     # re-runs bench.py itself at round end, so the sweep goes last-ish.
-    step "stage probe (native)" bash -c \
+    step "stage probe (native, fwd)" bash -c \
       "python scripts/stage_probe.py --batch 64 --dtype bfloat16 --conv_impl native && cp STAGE_PROBE.md STAGE_PROBE_native.md"
+    step "stage probe (native, fwd+bwd — the training cost)" bash -c \
+      "python scripts/stage_probe.py --batch 64 --dtype bfloat16 --conv_impl native --mode fwdbwd && cp STAGE_PROBE.md STAGE_PROBE_native_fwdbwd.md"
     step "XLA flag probe at the winning operating point" \
       python scripts/xla_flag_probe.py --batch 128
     step "bench sweep + train cross-check" bash scripts/tpu_smoke.sh
