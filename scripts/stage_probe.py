@@ -257,8 +257,8 @@ def main() -> None:
 
     # whole-trunk forward for reconciliation (sum of parts vs one program:
     # the difference is what XLA's cross-stage fusion buys)
-    trunk_fns = stage_apply(lambda m, v: m.forward_video(v))
-    trunk_probe = trunk_fns[1] if args.mode == "fwdbwd" else trunk_fns[0]
+    # stage_apply's second element is already the mode-appropriate probe
+    _, trunk_probe = stage_apply(lambda m, v: m.forward_video(v))
     x0 = device_input(1)
     t_trunk = _timed(trunk_probe, x0, args.iters)
     summary = {
@@ -285,7 +285,8 @@ def _write_md(records, args) -> None:
         "# Stage probe (auto-written by scripts/stage_probe.py)", "",
         f"- config: batch={args.batch} {args.frames}f@{args.size}^2 "
         f"dtype={args.dtype} conv_impl={args.conv_impl} mode={args.mode}"
-        + (" (per-stage fwd+bwd incl. param grads; roofline bound x3)"
+        + (" (per-stage fwd+bwd incl. param grads; bound heuristics: "
+           "FLOPs x3, x2 for param-free pools; bytes x2)"
            if args.mode == "fwdbwd" else ""),
         "- ms = chained-scan differenced host-materialized time; "
         "roofline_ms = max(FLOPs/peak, bytes/HBM) analytic bound; "

@@ -30,8 +30,10 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_cluster_matches_single_process():
+def _run_cluster_once():
+    """One two-process cluster attempt; returns (ok, outs, err_text).
+    ``err_text`` starts with 'TIMEOUT' only for the rendezvous/step
+    timeout case — the one failure mode the caller may retry."""
     port = _free_port()
     env = dict(os.environ)
     # the children must NOT inherit the parent's forced 8-device flag:
@@ -44,11 +46,15 @@ def test_two_process_cluster_matches_single_process():
         [sys.executable, child, str(pid), str(mh.NPROCS), str(port)],
         env=env, cwd=_REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
         for pid in range(mh.NPROCS)]
-    outs = []
+    outs, err_text = [], ""
     try:
         for p in procs:
-            out, err = p.communicate(timeout=600)
-            assert p.returncode == 0, err.decode(errors="replace")[-1500:]
+            try:
+                out, err = p.communicate(timeout=600)
+            except subprocess.TimeoutExpired:
+                return False, outs, "TIMEOUT: rendezvous/step >600s"
+            if p.returncode != 0:
+                return False, outs, err.decode(errors="replace")[-1500:]
             outs.append(out)
     finally:
         # one child dying (port race, coordinator failure) must not leave
@@ -57,6 +63,29 @@ def test_two_process_cluster_matches_single_process():
             if p.poll() is None:
                 p.kill()
                 p.communicate()
+    return True, outs, err_text
+
+
+@pytest.mark.slow
+def test_two_process_cluster_matches_single_process():
+    # One bounded retry, for the TIMEOUT case only: the rendezvous of
+    # two fresh processes on a saturated single-core CI host is
+    # inherently racy, and a timeout there is load, not a product bug.
+    # A child that CRASHES is never retried — a nondeterministic product
+    # failure must stay red.  A retried-then-green run still warns so a
+    # rising flake rate is visible before it becomes two-in-a-row.
+    import warnings
+
+    ok, outs, err_text = _run_cluster_once()
+    if not ok and err_text.startswith("TIMEOUT"):
+        first_err = err_text
+        ok, outs, err_text = _run_cluster_once()
+        if ok:
+            warnings.warn("multihost cluster needed a retry "
+                          f"(attempt 1: {first_err})")
+        else:
+            err_text = f"attempt1: {first_err}; attempt2: {err_text}"
+    assert ok, err_text
 
     losses = {}
     for out in outs:
