@@ -158,10 +158,23 @@ class Cv2Decoder:
 
     Same clip semantics as :class:`FFmpegDecoder`'s filter graph
     (video_loader.py:58-88): input-side seek, constant-rate fps resample
-    (duplicate/drop against source timestamps, the ``fps=`` filter rule),
-    fractional-offset square crop — direct ``size``-crop (crop_only,
-    :69-74) or largest-square crop + resize (:75-82) — and optional
-    hflip.  Decode runs in the calling loader thread with the GIL
+    (duplicate/drop against source timestamps), fractional-offset square
+    crop — direct ``size``-crop (crop_only, :69-74) or largest-square
+    crop + resize (:75-82) — and optional hflip, with two known
+    one-frame-scale divergences from the ffmpeg binary (ADVICE r3):
+
+    - the resample emits the LAST source frame with pts <= output pts
+      (floor), while ffmpeg's ``fps=`` filter default rounds to the
+      NEAREST source frame — for non-integer src/target fps ratios the
+      backends can select adjacent frames;
+    - ``CAP_PROP_POS_MSEC`` seek accuracy is container/keyframe
+      dependent, unlike ffmpeg's accurate input-side seek, so a clip may
+      start a frame or two off.
+
+    Both are below the granularity the model sees (clips are seconds
+    long at 5-16 fps with random jitter in training), but exact
+    frame-index parity across backends is NOT guaranteed and tests must
+    not assert it.  Decode runs in the calling loader thread with the GIL
     released inside cv2, so the thread pool scales like the pipe-pump
     path but with zero subprocess spawns and no rawvideo pipe traffic
     (a size-224 rgb24 frame is 150 KB on the pipe; cv2 hands back the

@@ -65,6 +65,18 @@ class CheckpointManager:
             import jax
             import jax.numpy as jnp
 
+            # The fallback exists for ONE cause: the stored opt_state's
+            # structure no longer matches the template's (optimizer tree
+            # evolved across releases).  The same exception types can also
+            # come from a transient Orbax failure on a perfectly
+            # compatible checkpoint — dropping the moments there would be
+            # silent data loss.  Discriminate with zero extra I/O by
+            # comparing leaf fingerprints (shape+dtype multisets) of the
+            # stored opt_state metadata vs the template's: identical
+            # fingerprints mean the structures are almost certainly
+            # compatible and the failure was something else — re-raise.
+            if self._opt_state_fingerprint_matches(latest, template):
+                raise
             _, raw = self.restore_raw(
                 latest, subtrees={"step", "params", "batch_stats"})
             if not isinstance(raw, dict):  # a TrainState restored as object
@@ -95,6 +107,47 @@ class CheckpointManager:
                 step=jnp.asarray(raw["step"]),
                 params=raw["params"],
                 batch_stats=raw.get("batch_stats", template.batch_stats))
+
+    def _opt_state_fingerprint_matches(self, epoch: int, template) -> bool:
+        """True when the stored checkpoint's opt_state leaves (from
+        metadata — no array I/O) sit at the same tree paths with the same
+        shape+dtype as the template's.  Container types differ between
+        the live pytree and Orbax metadata (optax NamedTuples serialize
+        as dicts keyed by field name, tuples as lists, field-less states
+        as None), so exact treedef equality is meaningless across that
+        boundary — but path *names* survive: GetAttrKey('mu') on the
+        live side becomes DictKey('mu') in metadata, SequenceKey indices
+        are preserved.  Comparing (path, shape, dtype) sets therefore
+        catches structure evolutions whose new states carry no array
+        leaves (e.g. wrapping in optax.chain(clip_by_global_norm, ...)
+        shifts every adam leaf's tuple index) that a flat leaf multiset
+        would miss.  Any error while comparing counts as a mismatch (the
+        fallback path then re-validates params structure strictly before
+        committing)."""
+        import jax
+
+        def key_name(k):
+            for attr in ("name", "key", "idx"):
+                if hasattr(k, attr):
+                    return str(getattr(k, attr))
+            return str(k)
+
+        def fp(tree):
+            is_arr = lambda x: hasattr(x, "shape")  # noqa: E731
+            flat, _ = jax.tree_util.tree_flatten_with_path(
+                tree, is_leaf=is_arr)
+            return sorted(
+                (tuple(key_name(k) for k in path),
+                 tuple(x.shape), str(jax.numpy.dtype(x.dtype)))
+                for path, x in flat if is_arr(x))
+
+        try:
+            # Orbax's TreeMetadata supports __getitem__ like the saved
+            # dict even though it is not a dict instance
+            stored_opt = self._mgr.item_metadata(epoch)["opt_state"]
+            return fp(stored_opt) == fp(template.opt_state)
+        except Exception:
+            return False
 
     def restore_raw(self, epoch: Optional[int] = None,
                     subtrees: Optional[set] = None):

@@ -57,14 +57,16 @@ def _measure(decoder, paths, n_clips: int, threads: int, num_frames: int,
     returns wall-clock clips/s (whole pool) and per-thread rate."""
     from milnce_tpu.data.video import sample_clip
 
-    rngs = [np.random.RandomState(1000 + t) for t in range(threads)]
     clip_sec = num_frames / float(fps)
     # keep every random seek inside the source so each draw decodes real
     # frames (a seek past EOF would zero-pad and inflate the rate)
     end = max(clip_sec, source_seconds - clip_sec - 0.5)
 
     def one(i):
-        rng = rngs[i % threads]
+        # fresh per-task RNG: tasks i and i+threads can run concurrently on
+        # different threads, so sharing a RandomState across tasks would
+        # mutate it unlocked (RandomState is not thread-safe)
+        rng = np.random.RandomState(1000 + i)
         path = paths[i % len(paths)]
         clip = sample_clip(decoder, path, 0.0, end, num_frames, fps, size,
                            rng, crop_only, False, True)
