@@ -113,10 +113,10 @@ def main(argv=None):
     variables = load_variables(args.ckpt, model, model_cfg, sample)
     # Orbax-restored arrays are committed to one device; replicate over the
     # mesh so they compose with the shard_map'ed embed fns (same fix as the
-    # train-resume path, train/loop.py).
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    # train-resume path, train/loop.py; multihost-safe assembly).
+    from milnce_tpu.parallel.mesh import replicate_to_mesh
 
-    variables = jax.device_put(variables, NamedSharding(mesh, P()))
+    variables = replicate_to_mesh(variables, mesh)
 
     from milnce_tpu.eval.runner import evaluate_task
 

@@ -54,7 +54,8 @@ def cdtw_loss(video_seq: jax.Array, text_seq: jax.Array, index: jax.Array | int,
 def sdtw_cidm_loss(video_seq: jax.Array, text_seq: jax.Array,
                    start: jax.Array, gamma: float = 0.1, sigma: float = 10.0,
                    lam: float = 1.0, backend: str = "scan",
-                   dist: str = "", bandwidth: int = 0) -> jax.Array:
+                   dist: str = "", bandwidth: int = 0,
+                   exact_broadcast: bool = False) -> jax.Array:
     """Soft-DTW + Clip-Interval-Distance-Metric regularizers (reference
     SDTW_CIDM, loss.py:34-68).
 
@@ -68,6 +69,15 @@ def sdtw_cidm_loss(video_seq: jax.Array, text_seq: jax.Array,
     frame-distance tensor, loss.py:59-66) and then mix sample with frame
     indices; we define the clip-pair distance cleanly as the cosine
     distance between frame-mean embeddings.
+
+    ``exact_broadcast=True`` reproduces the reference computation
+    bit-for-bit at the ONLY shape where it is defined (B == n): torch
+    right-aligns the (B,B) mask to (1,B,B), so
+    ``I_x[s] = sum_{i,j} mask(i,j)-weighted frame-distance D_x[s,i,j]``
+    — clip-pair weights applied to FRAME-pair distances.  Kept for
+    numerical parity audits against the reference
+    (tests/test_dtw_reference_golden.py); training uses the cleaned
+    form, which is shape-generic.
     """
     sdtw = SoftDTW(gamma=gamma, dist_func=dist or "cosine",
                    bandwidth=bandwidth, backend=backend)
@@ -75,12 +85,30 @@ def sdtw_cidm_loss(video_seq: jax.Array, text_seq: jax.Array,
     far = jnp.where(interval > sigma, 1.0, 0.0)
     w_ = interval + 1.0
     w = 1.0 / w_
-    v_mean = jnp.mean(video_seq, axis=1)
-    t_mean = jnp.mean(text_seq, axis=1)
-    d_x = 1.0 - _cosine_sim(v_mean[None], v_mean[None], 1e-8)[0]   # (B, B)
-    d_y = 1.0 - _cosine_sim(t_mean[None], t_mean[None], 1e-8)[0]
-    i_x = (far * w_ * jax.nn.relu(lam - d_x) + (1 - far) * w * d_x).sum(axis=1)
-    i_y = (far * w_ * jax.nn.relu(lam - d_y) + (1 - far) * w * d_y).sum(axis=1)
+    if exact_broadcast:
+        b, n, m = video_seq.shape[0], video_seq.shape[1], text_seq.shape[1]
+        if not (b == n == m):
+            raise ValueError(
+                f"exact_broadcast reproduces the reference's (B,B)x(B,n,n) "
+                f"broadcast, defined only when B == n (got B={b}, "
+                f"video n={n}, text m={m}); use the default cleaned form "
+                "for generic shapes")
+        # per-sample frame-pair cosine distances (loss.py:40-47): (B, n, n)
+        d_x = 1.0 - _cosine_sim(video_seq, video_seq, 1e-8)
+        d_y = 1.0 - _cosine_sim(text_seq, text_seq, 1e-8)
+        weight = lambda d: (far[None] * w_[None] * jax.nn.relu(lam - d)
+                            + (1 - far[None]) * w[None] * d)  # noqa: E731
+        i_x = weight(d_x).sum(axis=(1, 2))
+        i_y = weight(d_y).sum(axis=(1, 2))
+    else:
+        v_mean = jnp.mean(video_seq, axis=1)
+        t_mean = jnp.mean(text_seq, axis=1)
+        d_x = 1.0 - _cosine_sim(v_mean[None], v_mean[None], 1e-8)[0]  # (B, B)
+        d_y = 1.0 - _cosine_sim(t_mean[None], t_mean[None], 1e-8)[0]
+        i_x = (far * w_ * jax.nn.relu(lam - d_x)
+               + (1 - far) * w * d_x).sum(axis=1)
+        i_y = (far * w_ * jax.nn.relu(lam - d_y)
+               + (1 - far) * w * d_y).sum(axis=1)
     dtw = sdtw(video_seq, text_seq)
     return jnp.mean(i_x + i_y + dtw)
 

@@ -104,6 +104,25 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def replicate_to_mesh(tree, mesh: Mesh):
+    """Re-replicate host-local arrays (e.g. an Orbax restore committed to
+    one device) over a possibly MULTI-HOST mesh.
+
+    ``jax.device_put(x, NamedSharding(mesh, P()))`` raises on multi-host
+    CPU/TPU backends without DCN transfer flags ("does not support
+    cross-host device transfers") — but a replicated target needs no
+    transfer at all: every process already holds the full value, so the
+    global array is assembled from process-local data.  Single-process
+    keeps the plain device_put fast path.  (Found by the 4-process
+    cluster test resuming a checkpoint — tests/test_multihost.py.)"""
+    sh = NamedSharding(mesh, P())
+    if jax.process_count() == 1:
+        return jax.device_put(tree, sh)
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(
+            sh, np.asarray(x)), tree)
+
+
 def shard_batch(mesh: Mesh, batch, axis: str = "data"):
     """Device-put a host batch (pytree of arrays) sharded on dim 0."""
     sh = NamedSharding(mesh, P(axis))

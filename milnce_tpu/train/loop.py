@@ -134,11 +134,13 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
     resume_skip = 0
     if cfg.train.resume:
         start_epoch, state = manager.restore_latest(state)
-        # restored arrays are committed to one device; re-replicate over the
-        # mesh so they compose with the batch-sharded step inputs
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        # restored arrays are committed to one device; re-replicate over
+        # the mesh (multihost-safe: assembles from process-local data
+        # instead of a cross-host device_put) so they compose with the
+        # batch-sharded step inputs
+        from milnce_tpu.parallel.mesh import replicate_to_mesh
 
-        state = jax.device_put(state, NamedSharding(mesh, P()))
+        state = replicate_to_mesh(state, mesh)
         # Mid-epoch checkpoints (preemption / max_steps) are labeled with
         # the CURRENT epoch; the restored step counter places us inside it,
         # and the loader skips the consumed batches at the index level so

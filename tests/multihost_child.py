@@ -1,11 +1,33 @@
 """Child process + shared fixtures for test_multihost.py.
 
-As __main__: join a 2-process jax.distributed cluster over loopback
-(Gloo CPU collectives), run ONE sharded train step on a global mesh
-spanning both processes, print the loss as JSON.  This is the real
-multi-host path (parallel/mesh.py initialize_distributed with an
-explicit coordinator — the replacement for the reference's hardcoded-IP
-rendezvous, train.py:48-56), not the single-host no-op.
+As __main__: join an N-process jax.distributed cluster over loopback
+(Gloo CPU collectives) and run one of four modes on a global mesh
+spanning every process.  This is the real multi-host path
+(parallel/mesh.py initialize_distributed with an explicit coordinator —
+the replacement for the reference's hardcoded-IP rendezvous,
+train.py:48-56), not the single-host no-op.
+
+    python multihost_child.py <pid> <nprocs> <port> [mode] [workdir]
+
+modes:
+- ``step`` (default): ONE sharded train step, print the loss as JSON.
+- ``trainA``: multi-step loop with a cooperative-preemption protocol:
+  process 0 receives a REAL mid-run SIGTERM (delivered to itself after
+  step 2 — deterministic, same signal path as a TPU-VM maintenance
+  event); the handler only sets a flag, and between steps every process
+  all-reduces the flag over the mesh so the whole cluster agrees to
+  checkpoint together at the same step boundary (one worker exiting
+  unilaterally would wedge the others inside the next collective).
+  Saves via CheckpointManager (every process calls save; Orbax
+  coordinates the primary-host write), prints a record, exits 0.
+- ``trainB``: resume — restore_latest on EVERY process + the
+  ``device_put(state, NamedSharding(mesh, P()))`` re-replication that
+  train/loop.py's resume path uses (the multihost claim flagged by
+  ADVICE r3), then run to MAX_STEPS and print the final record.
+- ``fallback``: resume with an EVOLVED optimizer tree (chain-wrapped):
+  full restore fails structurally on every process, the per-path
+  fingerprint mismatches, and the weights-only fallback (restore_raw on
+  every process) must rescue the run cluster-wide.
 
 As a module: exposes the EXACT shapes/model/data used by the child so
 the parent test's in-process cross-check consumes one definition
@@ -15,6 +37,7 @@ main()).
 
 import json
 import os
+import signal
 import sys
 
 import numpy as np
@@ -24,23 +47,41 @@ sys.path.insert(0, _REPO)
 
 B_LOCAL, NPROCS, K, FRAMES, SIZE, WORDS = 2, 2, 2, 4, 32, 5
 B_GLOBAL = B_LOCAL * NPROCS
+MAX_STEPS = 6           # trainA preempts at 3; trainB finishes the rest
 
 
-def global_batch():
+def subprocess_env() -> dict:
+    """Environment for spawning a single-device-per-process child: the
+    parent pytest process forces 8 virtual CPU devices (conftest.py);
+    children must not inherit that flag."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f)
+    return env
+
+
+def global_batch(nprocs: int = NPROCS):
     """Identical deterministic global batch on every process; each holds
     its own slice (exactly the per-host loader contract)."""
     rng = np.random.RandomState(0)
-    video = rng.randint(0, 255, (B_GLOBAL, FRAMES, SIZE, SIZE, 3), np.uint8)
-    text = rng.randint(0, 32, (B_GLOBAL * K, WORDS)).astype(np.int32)
-    start = np.zeros((B_GLOBAL,), np.float32)
+    b = B_LOCAL * nprocs
+    video = rng.randint(0, 255, (b, FRAMES, SIZE, SIZE, 3), np.uint8)
+    text = rng.randint(0, 32, (b * K, WORDS)).astype(np.int32)
+    start = np.zeros((b,), np.float32)
     return video, text, start
+
+
+def _optim_cfg():
+    from milnce_tpu.config import OptimConfig
+
+    return OptimConfig(warmup_steps=2)
 
 
 def build_model_and_state():
     import jax
     import jax.numpy as jnp
 
-    from milnce_tpu.config import OptimConfig
     from milnce_tpu.models import S3D
     from milnce_tpu.train.schedule import build_schedule
     from milnce_tpu.train.state import build_optimizer, create_train_state
@@ -50,9 +91,61 @@ def build_model_and_state():
     variables = jax.jit(lambda key: model.init(
         key, jnp.zeros((2, FRAMES, SIZE, SIZE, 3), jnp.float32),
         jnp.zeros((2 * K, WORDS), jnp.int32)))(jax.random.PRNGKey(0))
-    ocfg = OptimConfig(warmup_steps=2)
+    ocfg = _optim_cfg()
     optimizer = build_optimizer(ocfg, build_schedule(ocfg, 10))
     return model, optimizer, create_train_state(variables, optimizer)
+
+
+def _shard_batch(mesh, nprocs: int, pid: int):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    video, text, start = global_batch(nprocs)
+    sharding = NamedSharding(mesh, P("data"))
+    lo, hi = pid * B_LOCAL, (pid + 1) * B_LOCAL
+    return (jax.make_array_from_process_local_data(sharding, video[lo:hi]),
+            jax.make_array_from_process_local_data(sharding,
+                                                   text[lo * K:hi * K]),
+            jax.make_array_from_process_local_data(sharding, start[lo:hi]))
+
+
+def _coord_barrier(name: str, timeout_ms: int = 600_000) -> None:
+    """Rendezvous on the COORDINATION SERVICE (gRPC), not on a device
+    collective: Gloo's key-value exchange has a hard 30 s timeout baked
+    into XLA, which N children skewed by concurrent backend-init/compile
+    on a saturated host routinely blow.  This barrier has a caller-chosen
+    timeout, so processes align here first and then hit the Gloo exchange
+    within milliseconds of each other."""
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is not None:
+        client.wait_at_barrier(name, timeout_in_ms=timeout_ms)
+
+
+def _flag_reducer(mesh):
+    """Cluster-wide OR of per-process preemption flags: each process
+    contributes one element of a mesh-sharded vector; the jitted sum is
+    the collective every worker sees identically."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P("data"))
+    # AOT-compile BEFORE any Gloo traffic: compilation is pure XLA (no
+    # communicator setup), so the barrier below can align processes
+    # before the first real collective executes.
+    reduce = jax.jit(lambda f: f.sum()).lower(
+        jax.ShapeDtypeStruct((jax.device_count(),), jnp.float32,
+                             sharding=sharding)).compile()
+
+    def any_flagged(local_flag: bool) -> bool:
+        per_dev = np.full((jax.local_device_count(),), float(local_flag),
+                          np.float32)
+        f = jax.make_array_from_process_local_data(sharding, per_dev)
+        return float(reduce(f)) > 0.0
+
+    return any_flagged
 
 
 def main() -> None:
@@ -66,33 +159,114 @@ def main() -> None:
     except Exception:
         pass  # older jax: default implementation
 
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
     from milnce_tpu.config import ParallelConfig
     from milnce_tpu.parallel.mesh import build_mesh, initialize_distributed
     from milnce_tpu.train.step import make_train_step
 
     pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
-    assert nprocs == NPROCS, (nprocs, NPROCS)
+    mode = sys.argv[4] if len(sys.argv) > 4 else "step"
+    workdir = sys.argv[5] if len(sys.argv) > 5 else ""
     pcfg = ParallelConfig(coordinator_address=f"127.0.0.1:{port}",
                           num_processes=nprocs, process_id=pid)
     initialize_distributed(pcfg)
     assert jax.process_count() == nprocs, jax.process_count()
 
-    video, text, start = global_batch()
     model, optimizer, state = build_model_and_state()
-
-    mesh = build_mesh(pcfg)             # spans BOTH processes' devices
-    sharding = NamedSharding(mesh, P("data"))
-    lo, hi = pid * B_LOCAL, (pid + 1) * B_LOCAL
-    video_g = jax.make_array_from_process_local_data(sharding, video[lo:hi])
-    text_g = jax.make_array_from_process_local_data(
-        sharding, text[lo * K:hi * K])
-    start_g = jax.make_array_from_process_local_data(sharding, start[lo:hi])
-
+    mesh = build_mesh(pcfg)             # spans every process's devices
+    any_flagged = _flag_reducer(mesh)   # AOT-compiled, no Gloo yet
+    # Establish the Gloo communicator NOW, with every process aligned by
+    # a coordination-service barrier first: the KV exchange + TCP pair
+    # connect then happen within ms of each other.  Without this, the
+    # first collective fires inside the S3D step's first execution, and
+    # with N children cold-compiling concurrently on a saturated host
+    # the 30 s Gloo timeouts trip before the slowest catches up.
+    _coord_barrier("milnce_gloo_warmup")
+    any_flagged(False)
+    video_g, text_g, start_g = _shard_batch(mesh, nprocs, pid)
     step = make_train_step(model, optimizer, mesh, donate=False)
-    _, loss = step(state, video_g, text_g, start_g)
-    print(json.dumps({"process": pid, "loss": float(loss)}), flush=True)
+
+    if mode == "step":
+        assert nprocs == NPROCS, (nprocs, NPROCS)
+        _, loss = step(state, video_g, text_g, start_g)
+        print(json.dumps({"process": pid, "loss": float(loss)}), flush=True)
+        # align exits: a worker held up in teardown (async Orbax, log
+        # flush) must not trip jax's fixed-timeout shutdown barrier for
+        # the whole cluster on a saturated host
+        _coord_barrier("milnce_exit")
+        return
+
+    from milnce_tpu.train.checkpoint import CheckpointManager
+
+    assert workdir, "trainA/trainB/fallback modes need a workdir argv"
+
+    if mode == "trainA":
+        preempted = {"flag": False}
+        signal.signal(signal.SIGTERM,
+                      lambda *_: preempted.update(flag=True))
+        mgr = CheckpointManager(workdir, keep=2)
+        s = 0
+        loss = None
+        while s < MAX_STEPS:
+            state, loss = step(state, video_g, text_g, start_g)
+            s += 1
+            flagged = any_flagged(preempted["flag"])
+            if pid == 0 and s == 2:
+                # the mid-run preemption under test: a real signal
+                # through the real handler, to ONE process only.  Sent
+                # AFTER this boundary's flag exchange (the handler runs
+                # synchronously on os.kill), so the cluster detects it
+                # at the step-3 boundary, mid-step like a real
+                # maintenance event.
+                os.kill(os.getpid(), signal.SIGTERM)
+            if flagged:
+                mgr.save(s, state)
+                mgr.wait()
+                break
+        print(json.dumps({"process": pid, "loss": float(loss),
+                          "steps_done": s,
+                          "preempted": bool(s < MAX_STEPS)}), flush=True)
+        _coord_barrier("milnce_exit")
+        return
+
+    if mode in ("trainB", "fallback"):
+        if mode == "fallback":
+            # the run was upgraded across an optimizer-tree change while
+            # preempted: full restore fails, weights-only fallback rescues
+            import optax
+
+            from milnce_tpu.train.schedule import build_schedule
+            from milnce_tpu.train.state import (build_optimizer,
+                                                create_train_state)
+
+            ocfg = _optim_cfg()
+            optimizer = optax.chain(
+                optax.clip_by_global_norm(1.0),
+                build_optimizer(ocfg, build_schedule(ocfg, 10)))
+            state = create_train_state(
+                {"params": state.params, "batch_stats": state.batch_stats},
+                optimizer)
+            step = make_train_step(model, optimizer, mesh, donate=False)
+        mgr = CheckpointManager(workdir, keep=2, create=False)
+        restored_step, state = mgr.restore_latest(state)
+        # the train/loop.py resume path's re-replication over the mesh
+        # (replicate_to_mesh: a plain device_put to a replicated spec
+        # raises 'does not support cross-host device transfers' here —
+        # the bug this phase exists to catch)
+        from milnce_tpu.parallel.mesh import replicate_to_mesh
+
+        state = replicate_to_mesh(state, mesh)
+        s = int(state.step)
+        loss = None
+        while s < MAX_STEPS:
+            state, loss = step(state, video_g, text_g, start_g)
+            s += 1
+        print(json.dumps({"process": pid, "loss": float(loss),
+                          "restored_step": restored_step,
+                          "final_step": int(state.step)}), flush=True)
+        _coord_barrier("milnce_exit")
+        return
+
+    raise SystemExit(f"unknown mode {mode!r}")
 
 
 if __name__ == "__main__":

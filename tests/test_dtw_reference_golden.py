@@ -166,6 +166,51 @@ class TestSDTWCIDMGolden:
         with pytest.raises(ValueError):
             np.broadcast_arrays(mask, frame_dist)
 
+    def test_exact_broadcast_matches_reference_at_b_equals_n(self):
+        """TRANSCRIPTION parity at the only shape where the reference's
+        formula is defined: exact_broadcast=True reproduces loss.py:59-67
+        — the (B,B) clip mask right-align-broadcast against the (B,n,n)
+        per-sample FRAME-distance tensor, sample/frame index mixing and
+        all — so the deviation is pinned numerically, not just argued."""
+        b = n = 5
+        d = 7
+        v, t = _seqs(b, n, n, d, seed=9)
+        start = np.array([0.0, 3.0, 14.0, 27.0, 55.0], np.float32)
+
+        dist = np.abs(start[:, None] - start[None, :])
+        y = (dist > self.SIGMA).astype(np.float64)
+        w_ = dist + 1.0
+        w = 1.0 / w_
+
+        def frame_cos_dist(a):                     # (B, n, n), loss.py:40-47
+            num = np.einsum("bnd,bmd->bnm", a, a)
+            nrm = np.linalg.norm(a, axis=-1)
+            return 1.0 - num / np.maximum(
+                nrm[:, :, None] * nrm[:, None, :], 1e-8)
+
+        d_x = frame_cos_dist(v.astype(np.float64))
+        d_y = frame_cos_dist(t.astype(np.float64))
+        # torch right-aligns (B,B) -> (1,B,B): clip-pair weights hit
+        # frame-pair distances (loss.py:65-66), then .sum(1).sum(1)
+        i_x = (y[None] * w_[None] * np.maximum(self.LAM - d_x, 0.0)
+               + (1 - y[None]) * w[None] * d_x).sum(axis=(1, 2))
+        i_y = (y[None] * w_[None] * np.maximum(self.LAM - d_y, 0.0)
+               + (1 - y[None]) * w[None] * d_y).sum(axis=(1, 2))
+        dtw = np_sdtw_cosine(v, t, self.GAMMA)
+        want = np.mean(i_x + i_y + dtw)
+
+        ours = float(sdtw_cidm_loss(jnp.asarray(v), jnp.asarray(t),
+                                    jnp.asarray(start), gamma=self.GAMMA,
+                                    sigma=self.SIGMA, lam=self.LAM,
+                                    exact_broadcast=True))
+        np.testing.assert_allclose(ours, want, rtol=1e-4)
+
+        # and the guard: any other shape is rejected loudly
+        v2, t2 = _seqs(4, 6, 6, d, seed=10)
+        with pytest.raises(ValueError, match="B == n"):
+            sdtw_cidm_loss(jnp.asarray(v2), jnp.asarray(t2),
+                           jnp.zeros((4,)), exact_broadcast=True)
+
 
 # ------------------------------------------------------------ SDTW_negative
 class TestSDTWNegativeGolden:

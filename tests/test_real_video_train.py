@@ -1,0 +1,53 @@
+"""Real-video train->eval loop (VERDICT r3 #5): actual encoded mp4
+bytes through the production pipeline — Cv2Decoder container decode,
+HowTo100M-style caption JSON -> MIL candidate windows, sharded MIL-NCE
+train step, Orbax checkpoint, and the real youcook eval CLI on held-out
+videos.  No FakeDecoder and no synthetic in-memory source anywhere.
+
+The committed 300-step run (REAL_TRAIN.md, scripts/real_train_eval.py)
+is the full-size record: loss 3.38 -> 1.62, held-out R@1 0.062 (chance)
+-> 0.562, MR 8.5 -> 1.0.  This test runs the same script scaled down
+(4 classes x 6 videos, 80 steps) in a subprocess WITHOUT the conftest's
+8-virtual-device flag: the committed run trains one data shard, and
+batch 8 split over 8 shards would give per-shard BatchNorm a single
+sample — a different (and much noisier) training regime than the one
+the thresholds were calibrated on (R@1 0.625, MR 1.0, loss -1.16).
+
+Reference equivalent: train.py:70-225 on real HowTo100M -> the
+README.md:114-129 table.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from multihost_child import subprocess_env
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_real_video_corpus_training_learns_retrieval(tmp_path):
+    pytest.importorskip("cv2")
+    env = subprocess_env()
+    report = tmp_path / "report.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "real_train_eval.py"),
+         "--root", str(tmp_path / "corpus"), "--steps", "80",
+         "--classes", "4", "--train_per_class", "6", "--eval_per_class", "2",
+         "--batch", "8", "--json_out", str(report)],
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=1500)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rep = json.loads(report.read_text())
+
+    # the loss moved substantially on real decoded content
+    assert rep["final_loss"] < rep["first_loss"] - 0.5, rep
+    # held-out retrieval through the eval CLI beats chance by >= 3x
+    # (calibrated point: R@1 0.625 vs chance 0.125)
+    assert rep["after"]["R1"] >= 3 * rep["chance_r1"], rep
+    assert rep["after"]["MR"] <= 2.0, rep
+    # and improved over the init checkpoint's ranking
+    assert rep["after"]["MR"] < rep["before"]["MR"], rep
