@@ -163,6 +163,15 @@ class TrainConfig:
                                         # activation memory — how the
                                         # reference's 8192-batch recipe runs
                                         # on a small mesh; train/step.py)
+    preempt_sync_steps: int = 25        # multi-process runs all-reduce the
+                                        # SIGTERM flag every N steps so ONE
+                                        # preempted worker triggers a
+                                        # cluster-wide cooperative checkpoint
+                                        # (a unilateral exit would wedge the
+                                        # others in their next collective);
+                                        # the check costs one tiny collective
+                                        # + host sync per N steps.  Single
+                                        # process: checked locally every step.
 
 
 @dataclass

@@ -104,6 +104,36 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def make_flag_reducer(mesh: Mesh):
+    """Cluster-wide OR of per-process boolean flags (e.g. "I received
+    SIGTERM"): each process contributes one element per local device of
+    a mesh-sharded vector; the jitted sum is a collective every worker
+    executes identically, so all of them see the same answer at the same
+    step — the primitive behind cooperative preemption (one worker
+    exiting unilaterally would wedge the rest inside their next
+    collective).
+
+    The reduction program is AOT-compiled here (compilation is pure XLA,
+    no communicator setup), so callers that need to align processes
+    before the first collective executes (Gloo CPU transports have a
+    hard 30 s setup timeout) can barrier between building and first use.
+    """
+    import jax.numpy as jnp
+
+    sharding = NamedSharding(mesh, P(mesh.axis_names))
+    reduce = jax.jit(lambda f: f.sum()).lower(
+        jax.ShapeDtypeStruct((jax.device_count(),), jnp.float32,
+                             sharding=sharding)).compile()
+
+    def any_flagged(local_flag: bool) -> bool:
+        per_dev = np.full((jax.local_device_count(),), float(local_flag),
+                          np.float32)
+        f = jax.make_array_from_process_local_data(sharding, per_dev)
+        return float(reduce(f)) > 0.0
+
+    return any_flagged
+
+
 def replicate_to_mesh(tree, mesh: Mesh):
     """Re-replicate host-local arrays (e.g. an Orbax restore committed to
     one device) over a possibly MULTI-HOST mesh.

@@ -31,8 +31,22 @@ class CheckpointManager:
             directory, options=options,
             item_handlers=ocp.StandardCheckpointHandler())
 
-    def save(self, epoch: int, state: TrainState) -> None:
-        self._mgr.save(epoch, args=ocp.args.StandardSave(state))
+    def save(self, epoch: int, state: TrainState,
+             force: bool = False) -> None:
+        """``force=True`` is for MID-EPOCH stops (preemption/max_steps)
+        labeled with the current epoch: the previous epoch's boundary
+        save already holds that label, and Orbax both silently refuses a
+        step <= the latest (should_save) and raises
+        StepAlreadyExistsError on a forced same-step save — either way
+        the partial epoch the preemption checkpoint exists to preserve
+        would be dropped.  Replace the boundary state with the
+        strictly-newer mid-epoch state (same run, larger step counter):
+        wait out any in-flight async save, delete the stale label, save.
+        """
+        if force and epoch in (self._mgr.all_steps() or []):
+            self._mgr.wait_until_finished()
+            self._mgr.delete(epoch)
+        self._mgr.save(epoch, args=ocp.args.StandardSave(state), force=force)
 
     def latest_epoch(self) -> Optional[int]:
         return self._mgr.latest_step()

@@ -175,6 +175,19 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
     except ValueError:           # non-main thread (tests)
         prev_handler = None
 
+    # Multi-process: a maintenance event may signal only SOME workers; a
+    # worker acting on its local flag alone would leave the rest wedged
+    # in their next collective.  All-reduce the flag every
+    # preempt_sync_steps so the whole cluster agrees to checkpoint at
+    # the same step boundary (tests/test_multihost.py drives this with a
+    # real one-worker SIGTERM).
+    multi = jax.process_count() > 1
+    if multi:
+        from milnce_tpu.parallel.mesh import make_flag_reducer
+
+        any_preempted = make_flag_reducer(mesh)
+        sync_every = max(1, cfg.train.preempt_sync_steps)
+
     # In-training eval cadence: every total_batch//512 epochs, like the
     # reference's gate (main_distributed.py:188-189) — which is dead code
     # there (undefined test_loader, SURVEY.md §2.4); here it works.
@@ -259,18 +272,32 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                     window = 0
                     timer.reset()
                     tick = time.time()
-                if preempted["flag"] or (max_steps is not None
-                                         and total_steps >= max_steps):
-                    if preempted["flag"]:
-                        logger.log("SIGTERM — checkpointing and exiting")
+                if multi:
+                    # every process evaluates the collective at the SAME
+                    # steps (total_steps advances in lockstep), so they
+                    # all see the same verdict
+                    stopping = (total_steps % sync_every == 0
+                                and any_preempted(preempted["flag"]))
+                else:
+                    stopping = preempted["flag"]
+                if stopping or (max_steps is not None
+                                and total_steps >= max_steps):
+                    if stopping:
+                        logger.log("SIGTERM — checkpointing and exiting"
+                                   + (" (cluster-coordinated)" if multi
+                                      else ""))
                     # mid-epoch stop: label the checkpoint with the CURRENT
                     # epoch so resume continues it (the restored step
                     # counter gives the batch offset).  A stop landing on
                     # the epoch's LAST batch must label epoch+1 — a
                     # current-epoch label with offset 0 would retrain the
-                    # whole epoch on resume.
+                    # whole epoch on resume.  force: the previous epoch's
+                    # boundary save holds the same label and Orbax would
+                    # otherwise silently skip this save, losing the
+                    # partial epoch (see CheckpointManager.save).
                     done = int(state.step) % steps_per_epoch == 0
-                    manager.save(epoch + 1 if done else epoch, state)
+                    manager.save(epoch + 1 if done else epoch, state,
+                                 force=not done)
                     manager.wait()
                     return TrainResult(state, total_steps,
                                        fetch(last_loss_dev))

@@ -124,28 +124,88 @@ def _coord_barrier(name: str, timeout_ms: int = 600_000) -> None:
 
 
 def _flag_reducer(mesh):
-    """Cluster-wide OR of per-process preemption flags: each process
-    contributes one element of a mesh-sharded vector; the jitted sum is
-    the collective every worker sees identically."""
+    """The production cooperative-preemption primitive
+    (parallel.mesh.make_flag_reducer): AOT-compiled, so the barrier in
+    main() can align processes before its first (Gloo-initializing)
+    execution."""
+    from milnce_tpu.parallel.mesh import make_flag_reducer
+
+    return make_flag_reducer(mesh)
+
+
+def _run_training_modes(pid: int, mode: str, workdir: str) -> None:
+    """Drive the PRODUCTION `run_training` loop across the cluster.
+
+    ``preempt_loop``: process 0 receives a real SIGTERM mid-run (a timer
+    thread — whenever it lands, the coordinated protocol converges); the
+    loop's cluster-wide flag all-reduce must make EVERY process
+    checkpoint at the same step and exit cleanly.
+    ``preempt_resume``: `--resume`-style restart of the same run dir on
+    every process (restore_latest + replicate_to_mesh inside
+    run_training), bounded by max_steps.
+    """
+    import threading
+
     import jax
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    sharding = NamedSharding(mesh, P("data"))
-    # AOT-compile BEFORE any Gloo traffic: compilation is pure XLA (no
-    # communicator setup), so the barrier below can align processes
-    # before the first real collective executes.
-    reduce = jax.jit(lambda f: f.sum()).lower(
-        jax.ShapeDtypeStruct((jax.device_count(),), jnp.float32,
-                             sharding=sharding)).compile()
+    from milnce_tpu.config import tiny_preset
+    from milnce_tpu.train.loop import run_training
 
-    def any_flagged(local_flag: bool) -> bool:
-        per_dev = np.full((jax.local_device_count(),), float(local_flag),
-                          np.float32)
-        f = jax.make_array_from_process_local_data(sharding, per_dev)
-        return float(reduce(f)) > 0.0
+    assert workdir, "preempt modes need a workdir argv"
 
-    return any_flagged
+    # pre-establish the Gloo communicator for this device clique (same
+    # barrier-then-trivial-collective recipe as the other modes: the S3D
+    # compile skew would otherwise trip Gloo's 30 s setup timeouts at
+    # the first train step); run_training's own mesh over the same
+    # devices reuses the cached communicator
+    from milnce_tpu.config import ParallelConfig
+    from milnce_tpu.parallel.mesh import build_mesh
+
+    warm = _flag_reducer(build_mesh(ParallelConfig()))
+    _coord_barrier("milnce_gloo_warmup")
+    warm(False)
+
+    cfg = tiny_preset()
+    # initialize_distributed already ran with the explicit coordinator;
+    # run_training must take the single-host no-op path, not re-init
+    cfg.parallel.coordinator_address = None
+    cfg.train.batch_size = 4            # 2 per process on a 2-proc cluster
+    cfg.data.synthetic_num_samples = 32
+    cfg.data.num_reader_threads = 2
+    cfg.train.n_display = 8
+    cfg.train.preempt_sync_steps = 4
+    cfg.train.checkpoint_root = workdir
+    cfg.train.log_root = ""
+    cfg.train.verbose = False
+    cfg.optim.epochs = 400              # far beyond the SIGTERM horizon
+
+    if mode == "preempt_loop":
+        if pid == 0:
+            # A real maintenance event would deliver SIGTERM once at an
+            # arbitrary time; before run_training installs its handler
+            # the default action would kill the process outright, so
+            # install a placeholder now and RE-send every 10 s until the
+            # production handler (installed mid-run) catches one — the
+            # coordinated protocol must converge whenever that happens.
+            signal.signal(signal.SIGTERM, lambda *_: None)
+
+            def fire():
+                os.kill(os.getpid(), signal.SIGTERM)
+                t = threading.Timer(10.0, fire)
+                t.daemon = True
+                t.start()
+
+            t0 = threading.Timer(15.0, fire)
+            t0.daemon = True
+            t0.start()
+        result = run_training(cfg)
+    else:
+        cfg.train.resume = True
+        result = run_training(cfg, max_steps=3)
+    print(json.dumps({"process": pid, "steps": result.steps,
+                      "step_counter": int(result.state.step),
+                      "loss": float(result.last_loss)}), flush=True)
+    _coord_barrier("milnce_exit")
 
 
 def main() -> None:
@@ -170,6 +230,10 @@ def main() -> None:
                           num_processes=nprocs, process_id=pid)
     initialize_distributed(pcfg)
     assert jax.process_count() == nprocs, jax.process_count()
+
+    if mode in ("preempt_loop", "preempt_resume"):
+        _run_training_modes(pid, mode, workdir)
+        return
 
     model, optimizer, state = build_model_and_state()
     mesh = build_mesh(pcfg)             # spans every process's devices

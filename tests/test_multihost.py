@@ -209,3 +209,30 @@ def test_four_process_sigterm_checkpoint_resume(tmp_path):
         assert rec_c[p]["final_step"] == mh.MAX_STEPS, rec_c[p]
         assert rec_c[p]["loss"] == pytest.approx(rec_c[0]["loss"], rel=1e-6)
     assert np.isfinite(rec_c[0]["loss"])
+
+
+@pytest.mark.slow
+def test_production_loop_coordinated_preemption(tmp_path):
+    """run_training itself (not a hand-rolled loop) across a 2-process
+    cluster: ONE worker receives a real SIGTERM at an arbitrary time;
+    the loop's preempt_sync_steps flag all-reduce must stop EVERY
+    process at the same step with a cooperative checkpoint, and a
+    `--resume`-style restart (restore_latest + replicate_to_mesh inside
+    the production loop) must continue on every process."""
+    workdir = str(tmp_path / "preempt_run")
+
+    rec = _run_cluster(nprocs=2, mode="preempt_loop", workdir=workdir)
+    # the run was cut short, at the SAME step on every process
+    assert rec[0]["steps"] == rec[1]["steps"], rec
+    assert 0 < rec[0]["steps"] < 3200, rec
+    assert rec[0]["step_counter"] == rec[1]["step_counter"], rec
+    assert rec[0]["loss"] == pytest.approx(rec[1]["loss"], rel=1e-6)
+    assert np.isfinite(rec[0]["loss"])
+
+    rec2 = _run_cluster(nprocs=2, mode="preempt_resume", workdir=workdir)
+    for p in range(2):
+        assert rec2[p]["steps"] == 3, rec2[p]
+        assert (rec2[p]["step_counter"]
+                == rec[0]["step_counter"] + 3), (rec, rec2)
+    assert rec2[0]["loss"] == pytest.approx(rec2[1]["loss"], rel=1e-6)
+    assert np.isfinite(rec2[0]["loss"])

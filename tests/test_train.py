@@ -651,6 +651,33 @@ def test_mid_epoch_resume_skips_consumed_batches(tiny_cfg, tmp_path):
 
 
 @pytest.mark.slow
+def test_mid_epoch_stop_after_completed_epoch_keeps_progress(tiny_cfg,
+                                                             tmp_path):
+    """A mid-epoch stop AFTER at least one completed epoch collides with
+    the boundary save's label (epoch 1 ends -> save(1); stop at step 6
+    of epoch 1 -> save(1) again): Orbax's should_save silently refuses a
+    step <= the latest, so without the forced save the partial epoch's
+    steps would be dropped while the log claims a checkpoint was written
+    (code-review r4 finding).  Resume must continue from step 6, not 4."""
+    import copy
+
+    from milnce_tpu.train.loop import run_training
+
+    cfg = copy.deepcopy(tiny_cfg)
+    cfg.train.checkpoint_root = str(tmp_path / "ckpt_collide")
+    cfg.data.synthetic_num_samples = 32          # 4 steps/epoch at batch 8
+    cfg.optim.epochs = 2
+    first = run_training(cfg, max_steps=6)       # epoch 0 done + 2 steps
+    assert first.steps == 6
+    cfg.train.resume = True
+    second = run_training(cfg)                   # finish epoch 1 only
+    assert second.steps == 2, (
+        f"mid-epoch checkpoint was dropped: resume ran {second.steps} "
+        "steps, expected 2")
+    assert int(second.state.step) == 8
+
+
+@pytest.mark.slow
 def test_boundary_stop_resumes_as_epoch_complete(tiny_cfg, tmp_path):
     """A stop landing exactly on the epoch's last batch must label the
     checkpoint epoch+1: resuming with epochs=1 has nothing left to run
