@@ -273,7 +273,8 @@ def main() -> None:
         f"- corpus: {rep['corpus']['n_train']} train / "
         f"{rep['corpus']['n_eval']} eval videos (8 classes, 20 s mpeg4 "
         f"64x64; decoded by Cv2Decoder, no FakeDecoder anywhere)",
-        f"- trained {rep['steps']} steps, batch 16, K=3 MIL candidates",
+        f"- trained {rep['steps']} steps, batch {args.batch}, "
+        f"K=3 MIL candidates",
         f"- loss: {rep['first_loss']:.4f} (first display window) -> "
         f"{rep['final_loss']:.4f} (final)",
         f"- loss trajectory (every 10 steps): "
