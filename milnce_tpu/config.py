@@ -172,6 +172,13 @@ class TrainConfig:
                                         # the check costs one tiny collective
                                         # + host sync per N steps.  Single
                                         # process: checked locally every step.
+                                        # TUNE to step time: a SIGTERM is only
+                                        # acted on at the next boundary, so the
+                                        # worst-case delay before checkpointing
+                                        # begins is N*step_time — keep that
+                                        # well inside the preemption grace
+                                        # window (e.g. 300ms steps + 30s grace
+                                        # -> N<=50; multi-second steps -> N<=5).
 
 
 @dataclass

@@ -104,7 +104,7 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def make_flag_reducer(mesh: Mesh):
+def make_flag_reducer(mesh: Mesh, overlap: bool = False):
     """Cluster-wide OR of per-process boolean flags (e.g. "I received
     SIGTERM"): each process contributes one element per local device of
     a mesh-sharded vector; the jitted sum is a collective every worker
@@ -117,19 +117,36 @@ def make_flag_reducer(mesh: Mesh):
     no communicator setup), so callers that need to align processes
     before the first collective executes (Gloo CPU transports have a
     hard 30 s setup timeout) can barrier between building and first use.
-    """
+
+    ``overlap=False`` (default): each call blocks the host on
+    ``float(reduce(f))`` — the verdict reflects the flags passed to THIS
+    call, at the cost of stalling the async-dispatch pipeline at every
+    sync boundary (ADVICE r4).  ``overlap=True`` pipelines instead: each
+    call enqueues this boundary's reduction and returns the PREVIOUS
+    boundary's verdict (False on the first call), so the host never
+    waits on an unfinished collective — detection latency grows by one
+    boundary (worst case 2 x preempt_sync_steps steps; budget the grace
+    window accordingly).  Both modes are cluster-uniform: every process
+    runs the same sequence, so all see the same verdict at the same
+    boundary."""
     import jax.numpy as jnp
 
     sharding = NamedSharding(mesh, P(mesh.axis_names))
     reduce = jax.jit(lambda f: f.sum()).lower(
         jax.ShapeDtypeStruct((jax.device_count(),), jnp.float32,
                              sharding=sharding)).compile()
+    pending = []                        # overlap mode: last enqueued result
 
     def any_flagged(local_flag: bool) -> bool:
         per_dev = np.full((jax.local_device_count(),), float(local_flag),
                           np.float32)
         f = jax.make_array_from_process_local_data(sharding, per_dev)
-        return float(reduce(f)) > 0.0
+        if not overlap:
+            return float(reduce(f)) > 0.0
+        out = reduce(f)                 # enqueue; don't materialize yet
+        verdict = float(pending.pop()) > 0.0 if pending else False
+        pending.append(out)
+        return verdict
 
     return any_flagged
 

@@ -17,11 +17,17 @@ import orbax.checkpoint as ocp
 from milnce_tpu.train.state import TrainState
 
 
+_STALE_PREFIX = "stale-epoch-"   # non-numeric => invisible to Orbax's step scan
+
+
 class CheckpointManager:
     def __init__(self, directory: str, keep: int = 10, create: bool = True):
         """``create=False`` opens read-only — export/inspection consumers
         must not mkdir a mistyped run directory as a side effect."""
         directory = os.path.abspath(directory)
+        self._directory = directory
+        if create:
+            self._recover_interrupted_replacements()
         options = ocp.CheckpointManagerOptions(
             max_to_keep=keep, create=create, read_only=not create,
             enable_async_checkpointing=True)
@@ -30,6 +36,45 @@ class CheckpointManager:
         self._mgr = ocp.CheckpointManager(
             directory, options=options,
             item_handlers=ocp.StandardCheckpointHandler())
+
+    def _sync(self, tag: str) -> None:
+        """Multi-host barrier around process-0 filesystem surgery; no-op
+        single-process."""
+        import jax
+
+        if jax.process_count() > 1:
+            ocp.multihost.sync_global_processes(f"milnce-ckpt-{tag}")
+
+    def _recover_interrupted_replacements(self) -> None:
+        """Finish any mid-epoch replacement (``save(force=True)``) that a
+        kill interrupted.  The replacement protocol renames the old
+        boundary checkpoint to ``stale-epoch-<n>`` before writing its
+        successor, so a crash in the window leaves the backup on disk:
+        if step ``<n>`` exists again the new save committed (Orbax's
+        commit is an atomic tmp->step rename) and the backup is garbage;
+        if it doesn't, restore the backup — the run keeps the boundary
+        checkpoint it had, instead of falling back a whole epoch."""
+        import re
+        import shutil
+
+        import jax
+
+        try:
+            entries = os.listdir(self._directory)
+        except FileNotFoundError:
+            return
+        if jax.process_index() == 0:
+            for name in entries:
+                m = re.fullmatch(_STALE_PREFIX + r"(\d+)", name)
+                if not m:
+                    continue
+                backup = os.path.join(self._directory, name)
+                step_dir = os.path.join(self._directory, m.group(1))
+                if os.path.isdir(step_dir):
+                    shutil.rmtree(backup)
+                else:
+                    os.rename(backup, step_dir)
+        self._sync("recover")
 
     def save(self, epoch: int, state: TrainState,
              force: bool = False) -> None:
@@ -40,12 +85,41 @@ class CheckpointManager:
         StepAlreadyExistsError on a forced same-step save — either way
         the partial epoch the preemption checkpoint exists to preserve
         would be dropped.  Replace the boundary state with the
-        strictly-newer mid-epoch state (same run, larger step counter):
-        wait out any in-flight async save, delete the stale label, save.
-        """
+        strictly-newer mid-epoch state (same run, larger step counter).
+
+        Crash safety: the stale checkpoint is MOVED ASIDE (atomic
+        rename to ``stale-epoch-<n>``), not deleted, before the new save
+        starts, and only removed after the new save has committed — a
+        SIGKILL anywhere in the window leaves either the old or the new
+        checkpoint recoverable (``_recover_interrupted_replacements`` on
+        the next open).  The forced path is synchronous; preemption
+        callers wait() immediately anyway."""
+        import shutil
+
+        import jax
+
         if force and epoch in (self._mgr.all_steps() or []):
             self._mgr.wait_until_finished()
-            self._mgr.delete(epoch)
+            stale = os.path.join(self._directory, str(epoch))
+            backup = os.path.join(self._directory,
+                                  f"{_STALE_PREFIX}{epoch}")
+            have_backup = os.path.isdir(stale)
+            if have_backup:
+                self._sync("pre-rename")
+                if jax.process_index() == 0:
+                    os.rename(stale, backup)
+                self._sync("renamed")
+                self._mgr.reload()          # drop the cached step listing
+            else:                           # step tracked but dir absent
+                self._mgr.delete(epoch)     # (custom storage) — old path
+            self._mgr.save(epoch, args=ocp.args.StandardSave(state),
+                           force=force)
+            self._mgr.wait_until_finished()  # commit before dropping backup
+            if have_backup:
+                if jax.process_index() == 0 and os.path.isdir(backup):
+                    shutil.rmtree(backup)
+                self._sync("committed")
+            return
         self._mgr.save(epoch, args=ocp.args.StandardSave(state), force=force)
 
     def latest_epoch(self) -> Optional[int]:
@@ -161,6 +235,15 @@ class CheckpointManager:
             stored_opt = self._mgr.item_metadata(epoch)["opt_state"]
             return fp(stored_opt) == fp(template.opt_state)
         except Exception:
+            # Failing to COMPUTE the fingerprint (metadata-API drift, a
+            # checkpoint missing opt_state metadata) silently reverts to
+            # the pre-fingerprint behavior — weights-only fallback even
+            # on transient errors.  Make that regression visible.
+            import logging
+            logging.getLogger(__name__).warning(
+                "opt_state fingerprint comparison for checkpoint %d "
+                "failed; transient-vs-structural discrimination is "
+                "disabled for this restore", epoch, exc_info=True)
             return False
 
     def restore_raw(self, epoch: Optional[int] = None,

@@ -205,6 +205,71 @@ def test_resume_survives_optimizer_structure_change(tmp_path):
             == jax.tree_util.tree_structure(chain_template.opt_state))
 
 
+def test_forced_save_crash_window_recovers_boundary_checkpoint(tmp_path):
+    """A SIGKILL between moving the stale epoch-boundary checkpoint aside
+    and committing its mid-epoch replacement (save(force=True)) must not
+    lose the boundary save: the replacement protocol renames rather than
+    deletes, and the next CheckpointManager open finishes the protocol in
+    whichever direction is safe (ADVICE r4, train/checkpoint.py)."""
+    import os
+    import shutil
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from milnce_tpu.config import OptimConfig
+    from milnce_tpu.models import S3D
+    from milnce_tpu.train.checkpoint import CheckpointManager
+    from milnce_tpu.train.schedule import build_schedule
+    from milnce_tpu.train.state import build_optimizer, create_train_state
+
+    model = S3D(num_classes=16, vocab_size=32, word_embedding_dim=8,
+                text_hidden_dim=16, inception_blocks=1)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, 4, 32, 32, 3), jnp.float32),
+                           jnp.zeros((4, 5), jnp.int32))
+    opt = build_optimizer(OptimConfig(name="adam", warmup_steps=2),
+                          build_schedule(OptimConfig(), 10))
+    boundary = create_train_state(variables, opt).replace(
+        step=jnp.asarray(4, jnp.int32))
+    run = str(tmp_path / "run")
+    mgr = CheckpointManager(run, keep=3)
+    mgr.save(1, boundary)
+    mgr.close()
+
+    # Simulate the kill window: the stale boundary save was moved aside
+    # but the replacement never committed.
+    os.rename(os.path.join(run, "1"), os.path.join(run, "stale-epoch-1"))
+    mgr2 = CheckpointManager(run, keep=3)            # recovery sweep runs
+    assert os.path.isdir(os.path.join(run, "1"))
+    assert not os.path.isdir(os.path.join(run, "stale-epoch-1"))
+    template = create_train_state(variables, opt)
+    epoch, restored = mgr2.restore_latest(template)
+    assert epoch == 1 and int(restored.step) == 4    # boundary save intact
+
+    # Happy-path replacement: forced save commits, backup is gone,
+    # restore sees the strictly-newer mid-epoch state.
+    mid_epoch = boundary.replace(step=jnp.asarray(6, jnp.int32))
+    mgr2.save(1, mid_epoch, force=True)
+    mgr2.close()
+    assert not os.path.isdir(os.path.join(run, "stale-epoch-1"))
+    mgr3 = CheckpointManager(run, keep=3, create=False)
+    _, restored3 = mgr3.restore_latest(template)
+    assert int(restored3.step) == 6
+    mgr3.close()
+
+    # Kill AFTER commit but before backup cleanup: the committed step
+    # wins and the orphaned backup is garbage-collected on open.
+    shutil.copytree(os.path.join(run, "1"),
+                    os.path.join(run, "stale-epoch-1"))
+    mgr4 = CheckpointManager(run, keep=3)
+    assert not os.path.isdir(os.path.join(run, "stale-epoch-1"))
+    _, restored4 = mgr4.restore_latest(template)
+    assert int(restored4.step) == 6
+    mgr4.close()
+
+
 def _eval_csvs(tmp_path):
     import csv as csv_mod
 

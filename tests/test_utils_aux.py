@@ -159,3 +159,27 @@ class TestNaNGuard:
         cfg.optim.warmup_steps = 0
         result = run_training(cfg, max_steps=2)
         assert result.steps == 2
+
+
+class TestFlagReducer:
+    def test_overlap_mode_pipelines_one_boundary_behind(self):
+        """overlap=True returns the PREVIOUS boundary's verdict (never
+        blocks on the collective it just enqueued): a flag raised at
+        boundary k is visible at k+1, uniformly across the mesh
+        (ADVICE r4, parallel/mesh.py)."""
+        import jax
+
+        from milnce_tpu.config import ParallelConfig
+        from milnce_tpu.parallel.mesh import build_mesh, make_flag_reducer
+
+        mesh = build_mesh(ParallelConfig(), jax.devices())
+
+        blocking = make_flag_reducer(mesh)
+        assert blocking(False) is False
+        assert blocking(True) is True            # same-boundary verdict
+
+        lagged = make_flag_reducer(mesh, overlap=True)
+        assert lagged(False) is False            # nothing pending yet
+        assert lagged(True) is False             # enqueued, not yet read
+        assert lagged(False) is True             # previous boundary's flag
+        assert lagged(False) is False
