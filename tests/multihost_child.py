@@ -259,6 +259,36 @@ def main() -> None:
         _coord_barrier("milnce_exit")
         return
 
+    if mode == "cdtw_step":
+        # the DTW-family collective pattern is DIFFERENT from MIL-NCE:
+        # all_gather of sequence embeddings + replicated loss + pmean of
+        # grads (vs psum of partial sums) — virtual meshes proved the
+        # math, this proves it across a real process boundary
+        # (VERDICT r4 #5; reference counterpart: the NCCL gather at
+        # train.py:217-219)
+        from milnce_tpu.config import LossConfig
+
+        step = make_train_step(model, optimizer, mesh, donate=False,
+                               loss_cfg=LossConfig(name="cdtw"))
+        _, loss = step(state, video_g, text_g, start_g)
+        print(json.dumps({"process": pid, "loss": float(loss)}), flush=True)
+        _coord_barrier("milnce_exit")
+        return
+
+    if mode == "gradcache_step":
+        # two-pass embedding-cache step (scan embed -> global loss ->
+        # VJP re-forward) with its own collective placement; the r4
+        # restore bug showed exactly this class of program needs a real
+        # process boundary to be trusted (VERDICT r4 #5)
+        from milnce_tpu.train.step import make_grad_cache_step
+
+        step = make_grad_cache_step(model, optimizer, mesh,
+                                    micro_batches=2, donate=False)
+        _, loss = step(state, video_g, text_g, start_g)
+        print(json.dumps({"process": pid, "loss": float(loss)}), flush=True)
+        _coord_barrier("milnce_exit")
+        return
+
     from milnce_tpu.train.checkpoint import CheckpointManager
 
     assert workdir, "trainA/trainB/fallback modes need a workdir argv"

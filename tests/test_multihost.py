@@ -141,30 +141,60 @@ def _run_cluster(nprocs: int = mh.NPROCS, mode: str = "step",
 
 @pytest.mark.slow
 def test_two_process_cluster_matches_single_process():
+    from milnce_tpu.train.step import make_train_step
+
     records = _run_cluster()
+    _cross_check_mode(records, lambda m, o, mesh: make_train_step(
+        m, o, mesh, donate=False))
+
+
+def _cross_check_mode(records, build_step):
+    """Shared body for the per-step-program cluster tests: both
+    processes computed the same mesh-global loss, and it matches the
+    identical program run in-process on a 2-shard virtual mesh (local
+    BatchNorm makes shard count part of the semantics, as the grad-cache
+    microbatch==virtual-shard tests pin)."""
     losses = {p: r["loss"] for p, r in records.items()}
-    # the loss is mesh-global: both processes must compute the same value
     assert losses[0] == pytest.approx(losses[1], rel=1e-6)
     assert np.isfinite(losses[0])
 
-    # cross-check the SAME global batch in-process, on the SAME shard
-    # layout (2 shards): local BatchNorm computes per-shard statistics,
-    # so shard count is part of the semantics (as the grad-cache
-    # microbatch==virtual-shard tests pin)
     import jax
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    from milnce_tpu.train.step import make_train_step
-
     video, text, start = mh.global_batch()
     model, optimizer, state = mh.build_model_and_state()
-
     mesh = Mesh(np.asarray(jax.devices()[:mh.NPROCS]), ("data",))
     sh = NamedSharding(mesh, P("data"))
-    step = make_train_step(model, optimizer, mesh, donate=False)
+    step = build_step(model, optimizer, mesh)
     _, loss = step(state, jax.device_put(video, sh),
                    jax.device_put(text, sh), jax.device_put(start, sh))
     assert losses[0] == pytest.approx(float(loss), rel=2e-5)
+
+
+@pytest.mark.slow
+def test_two_process_cdtw_step_matches_single_process():
+    """The DTW-family step's collective pattern (all_gather sequence
+    embeddings -> replicated loss -> pmean grads) across a REAL process
+    boundary — the virtual-mesh tests can't catch transport-layer bugs
+    (VERDICT r4 #5)."""
+    from milnce_tpu.config import LossConfig
+    from milnce_tpu.train.step import make_train_step
+
+    records = _run_cluster(mode="cdtw_step")
+    _cross_check_mode(records, lambda m, o, mesh: make_train_step(
+        m, o, mesh, donate=False, loss_cfg=LossConfig(name="cdtw")))
+
+
+@pytest.mark.slow
+def test_two_process_gradcache_step_matches_single_process():
+    """The two-pass embedding-cache step (grad_accum=2) across a REAL
+    process boundary: scan-embed, mesh-global loss on cached embeddings,
+    VJP re-forward, psum — each collective crossing Gloo (VERDICT r4 #5)."""
+    from milnce_tpu.train.step import make_grad_cache_step
+
+    records = _run_cluster(mode="gradcache_step")
+    _cross_check_mode(records, lambda m, o, mesh: make_grad_cache_step(
+        m, o, mesh, micro_batches=2, donate=False))
 
 
 @pytest.mark.slow

@@ -51,3 +51,36 @@ def test_real_video_corpus_training_learns_retrieval(tmp_path):
     assert rep["after"]["MR"] <= 2.0, rep
     # and improved over the init checkpoint's ranking
     assert rep["after"]["MR"] < rep["before"]["MR"], rep
+
+
+@pytest.mark.slow
+def test_real_video_training_bf16_with_linear_probe(tmp_path):
+    """The bench operating point's numerics actually train (VERDICT r4
+    #3): the same real-mp4 loop with model.dtype=bfloat16 must show the
+    same qualitative behavior as the calibrated f32 run — loss drop,
+    held-out retrieval above chance — and the HMDB-style linear probe
+    (VERDICT r4 #4: mixed_5c -> LinearSVC per split -> window-summed
+    top-1, real decoded bytes end to end) must beat chance after
+    training."""
+    pytest.importorskip("cv2")
+    pytest.importorskip("sklearn")
+    env = subprocess_env()
+    report = tmp_path / "report.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "real_train_eval.py"),
+         "--root", str(tmp_path / "corpus"), "--steps", "80",
+         "--classes", "4", "--train_per_class", "6", "--eval_per_class", "2",
+         "--batch", "8", "--dtype", "bfloat16", "--probe",
+         "--json_out", str(report)],
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=1800)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rep = json.loads(report.read_text())
+
+    # bf16 numerics track the f32 regime: substantial loss drop, no NaNs
+    assert rep["final_loss"] < rep["first_loss"] - 0.5, rep
+    # held-out retrieval through the eval CLI beats chance
+    assert rep["after"]["R1"] >= 3 * rep["chance_r1"], rep
+    assert rep["after"]["MR"] < rep["before"]["MR"], rep
+    # the linear probe on real bytes separates the classes well above
+    # chance (0.25 at 4 classes) once the trunk is trained
+    assert rep["probe_after"]["mean"] >= 2 * rep["probe_chance"], rep
