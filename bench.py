@@ -200,8 +200,8 @@ def _step_flops(step_fn, args):
 def _bench_config(dtype: str, batch: int, frames: int, size: int,
                   words: int, k: int, remat: bool,
                   inner: int = 1, s2d: bool = False,
-                  conv_impl: str = "native", loss: str = "milnce",
-                  grad_accum: int = 1,
+                  conv_impl: str = "native", conv_impl_map: str = "",
+                  loss: str = "milnce", grad_accum: int = 1,
                   peak: float | None = None, flops_hint: float | None = None):
     """Time the full train step at one operating point.
 
@@ -229,6 +229,9 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
     cfg.model.remat = remat
     cfg.model.space_to_depth = s2d
     cfg.model.conv_impl = conv_impl
+    # per-stage overrides: inline spec or stage_probe --autotune artifact
+    # path (config.parse_conv_impl_map handles both)
+    cfg.model.conv_impl_map = conv_impl_map
     model = build_model(cfg.model)
     mesh = build_mesh(cfg.parallel)
 
@@ -391,6 +394,7 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
         "remat": remat,
         "s2d": s2d,
         "conv_impl": conv_impl,
+        "impl_map": conv_impl_map,
         "loss": loss,
         "grad_accum": grad_accum,
         "inner": inner,
@@ -453,28 +457,60 @@ def _run_config(timeout_s: float | None = None, **kwargs):
     batch-32 row), so every config starts in a fresh process.
 
     Raises RuntimeError carrying the child's error text (so the caller's
-    OOM detection keeps working) or a 'config timeout' marker."""
+    OOM detection keeps working) or a 'config timeout' marker.  The
+    child's stderr is captured and re-streamed to OUR stderr; when the
+    child dies with no record the stderr tail rides in the exception —
+    an rc=1 before jax even initializes (e.g. an XLA_FLAGS the client's
+    flag parser rejects, the round-5 xla_flag_probe failure mode) used
+    to surface as a bare 'no record' with the diagnosis lost."""
     global _ACTIVE_CHILD_PROC
     env = dict(os.environ)
     env[_CONFIG_ENV] = json.dumps(kwargs)
     env.pop(_CHILD_MODE_ENV, None)
     proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
-                            env=env, cwd=_REPO, stdout=subprocess.PIPE)
+                            env=env, cwd=_REPO, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE)
     _ACTIVE_CHILD_PROC = proc
+    err = b""
     try:
-        out, _ = proc.communicate(timeout=timeout_s)
+        out, err = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
+        # keep DRAINING the pipes while the TERM grace runs: a child
+        # flushing a large XLA/traceback tail into a full 64KB pipe
+        # would otherwise block, ignore the TERM, and get hard-killed —
+        # the exact live-TPU-client kill that wedges the relay for every
+        # later client (_graceful_stop notes)
+        drained = {}
+
+        def _drain():
+            drained["out"], drained["err"] = proc.communicate()
+
+        reader = threading.Thread(target=_drain, daemon=True)
+        reader.start()
         _graceful_stop(proc)
+        reader.join(timeout=10)
+        _relay_child_stderr(drained.get("err") or b"")
         raise RuntimeError(f"config timeout>{timeout_s}s: {kwargs}")
     finally:
         _ACTIVE_CHILD_PROC = None
+    _relay_child_stderr(err)
     rec = _last_tagged_json(
         out or b"", lambda r: "config_result" in r or "config_error" in r)
     if rec is None:
-        raise RuntimeError(f"config child rc={proc.returncode}, no record")
+        tail = (err or b"").decode(errors="replace").strip()[-2000:]
+        raise RuntimeError(f"config child rc={proc.returncode}, no record; "
+                           f"stderr tail: {tail or '(empty)'}")
     if "config_error" in rec:
         raise RuntimeError(rec["config_error"])
     return rec["config_result"]
+
+
+def _relay_child_stderr(err: bytes) -> None:
+    """Captured child stderr still belongs on our stderr (the sweep's
+    per-config diagnostics read it live before capture existed)."""
+    if err:
+        sys.stderr.write(err.decode(errors="replace"))
+        sys.stderr.flush()
 
 
 def _is_oom(exc) -> bool:
@@ -490,7 +526,9 @@ def _make_record(best, frames, size, on_tpu, kind):
                   f"{best['dtype']}, batch {best['batch']}"
                   + (", s2d stem" if best.get("s2d") else "")
                   + (", fold2d convs"
-                     if best.get("conv_impl") == "fold2d" else "") + ")",
+                     if best.get("conv_impl") == "fold2d" else "")
+                  + (", tuned impl map"
+                     if best.get("impl_map") else "") + ")",
         "value": value,
         "unit": "clips/sec/chip",
         # ratio vs the recorded TPU anchor — only meaningful on TPU (a
@@ -534,10 +572,17 @@ def run_bench(on_tpu: bool, info: dict):
     # training used) — densifies conv1, the stage most starved on the
     # 128-wide MXU (see BENCH_NOTES.md headroom notes)
     s2d = os.environ.get("MILNCE_BENCH_S2D") == "1"
-    # conv lowering for the sweep: 'native' 3D convs or 'fold2d' (2D-conv
-    # decomposition, models/conv3d.py); a fold2d row is also auto-measured
-    # at the winning operating point (opt out: MILNCE_BENCH_FOLD2D=0)
+    # conv lowering for the sweep: 'native' 3D convs, 'fold2d' (2D-conv
+    # decomposition) or 'im2col' (patches + one dot_general,
+    # models/conv3d.py); a fold2d row is also auto-measured at the
+    # winning operating point (opt out: MILNCE_BENCH_FOLD2D=0)
     conv_impl = os.environ.get("MILNCE_BENCH_CONV", "native")
+    # per-stage impl map for every sweep row: inline spec or the
+    # stage_probe --autotune artifact path (absolute, or relative to the
+    # repo root so the child resolves it from its own cwd)
+    impl_map = os.environ.get("MILNCE_BENCH_IMPL_MAP", "")
+    if impl_map and "=" not in impl_map and not os.path.isabs(impl_map):
+        impl_map = os.path.join(_REPO, impl_map)
     if on_tpu:
         frames, size, words, k = 16, 224, 20, 5
         # differenced W(k2)-W(k1) timing cancels dispatch latency, so the
@@ -574,14 +619,16 @@ def run_bench(on_tpu: bool, info: dict):
         return linear * batch / b0 + milnce_logits_flops(batch, k)
 
     def measure(dtype, batch, remat, s2d, conv_impl, loss="milnce",
-                grad_accum=1, timeout_s=None):
+                grad_accum=1, timeout_s=None, conv_impl_map=None):
         return _run_config(
             timeout_s=timeout_s or cfg_timeout,
             platform_pin=None if on_tpu else "cpu",
             dtype=dtype, batch=batch, frames=frames,
             size=size, words=words, k=k, remat=remat,
             inner=1 if grad_accum > 1 else inner, s2d=s2d,
-            conv_impl=conv_impl, loss=loss, grad_accum=grad_accum, peak=peak,
+            conv_impl=conv_impl,
+            conv_impl_map=impl_map if conv_impl_map is None else conv_impl_map,
+            loss=loss, grad_accum=grad_accum, peak=peak,
             flops_hint=None if grad_accum > 1
             else hint(dtype, remat, s2d, batch))
 
@@ -635,6 +682,18 @@ def run_bench(on_tpu: bool, info: dict):
             if r["flops_per_step"] and r.get("flops_source") == "xla":
                 flops_seen.setdefault((dtype, remat, s2d),
                                       (batch, r["flops_per_step"]))
+            if prev and r["clips_per_sec_per_chip"] < 0.90 * prev:
+                # >10% regression vs a SMALLER batch is not the usual
+                # diminishing-returns knee — it's a padded-batch/tiling
+                # cliff (the observed 281-vs-393 clips/s drop at batch
+                # 192; PERF.md "Batch cliffs") and the row is flagged so
+                # BENCH_NOTES readers don't average across it
+                r["cliff_vs_smaller_batch"] = round(
+                    1.0 - r["clips_per_sec_per_chip"] / prev, 3)
+                _note(f"bench: {dtype} batch={batch} regresses "
+                      f"{100 * r['cliff_vs_smaller_batch']:.0f}% vs the "
+                      "smaller batch — padded-batch/tiling cliff "
+                      "(PERF.md)")
             _note(f"bench: {r}")
             results.append(r)
             # Interim record after every config: a later config hanging
@@ -690,20 +749,34 @@ def run_bench(on_tpu: bool, info: dict):
     # training used the s2d stem (s3dg.py:214-215, 248-253) precisely
     # because it densifies conv1 for the MXU — always measure the
     # comparison (opt out: MILNCE_BENCH_S2D=0).
+    # comparison rows pin conv_impl_map="" so each measures its PURE
+    # configuration — with a global MILNCE_BENCH_IMPL_MAP the sweep rows
+    # carry the map (the operating point) while these stay the labeled
+    # baselines they claim to be (an s2d row under a plain-stem-tuned
+    # map would even misapply the conv1 entry to the 2x4x4 kernel)
     if on_tpu and not s2d and os.environ.get("MILNCE_BENCH_S2D") != "0":
-        extra_row("s2d", s2d=True)
+        extra_row("s2d", s2d=True, conv_impl_map="")
     # fold2d row: same math lowered as 2D convs (models/conv3d.py) — if
     # XLA's 3D-conv tiling is the MFU sink (PERF.md headroom reading)
     # this row shows it directly.
     if (on_tpu and conv_impl == "native"
             and os.environ.get("MILNCE_BENCH_FOLD2D") != "0"):
-        extra_row("fold2d", conv_impl="fold2d")
+        extra_row("fold2d", conv_impl="fold2d", conv_impl_map="")
+    # im2col-stem row: the fwd+bwd stage probe convicts conv1 (1% of
+    # peak, 102x roofline — STAGE_PROBE_native_fwdbwd.md); this measures
+    # the patches+dot_general stem at the winning operating point.  A
+    # full autotuned map (MILNCE_BENCH_IMPL_MAP) supersedes it (opt out:
+    # MILNCE_BENCH_IM2COL=0).
+    if (on_tpu and conv_impl == "native" and not impl_map
+            and os.environ.get("MILNCE_BENCH_IM2COL") != "0"):
+        extra_row("im2col_stem", s2d=False, conv_impl_map="conv1=im2col")
     # DTW-family row: the Pallas soft-DTW kernel inside the FULL compiled
     # train step (loss sdtw_3, backend auto) at the winning operating
     # point — the fork's signature loss measured on the real chip, not
     # just in the kernel microbench (opt out: MILNCE_BENCH_SDTW=0).
     if on_tpu and os.environ.get("MILNCE_BENCH_SDTW") != "0":
-        extra_row("sdtw_3", loss="sdtw_3", s2d=False, conv_impl="native")
+        extra_row("sdtw_3", loss="sdtw_3", s2d=False, conv_impl="native",
+                  conv_impl_map="")
     # North-star recipe row: the per-chip slice of the 8192-global-batch
     # training step — 8 embedding-cache microbatches of the winning batch
     # in ONE update (BASELINE.md HMDB-53.1 recipe; memory- and
@@ -712,7 +785,7 @@ def run_bench(on_tpu: bool, info: dict):
     # MILNCE_BENCH_GRAD_ACCUM=0).
     if on_tpu and os.environ.get("MILNCE_BENCH_GRAD_ACCUM") != "0":
         extra_row("grad_accum8", batch=8 * best["batch"], grad_accum=8,
-                  s2d=False, conv_impl="native",
+                  s2d=False, conv_impl="native", conv_impl_map="",
                   timeout_s=2 * cfg_timeout)
 
     _write_notes(results, best, kind, on_tpu, n_devices,
@@ -728,34 +801,59 @@ def run_bench(on_tpu: bool, info: dict):
 
 def _write_notes(results, best, kind, on_tpu, n_chips, truncated=False):
     notes = os.path.join(_REPO, "BENCH_NOTES.md")
-    if not on_tpu and os.path.exists(notes):
-        # never clobber a real-TPU sweep with CPU-fallback numbers
+    hand_notes = ""
+    if os.path.exists(notes):
         with open(notes) as fh:
-            if "on_tpu=True" in fh.read():
-                _note("bench: keeping existing TPU BENCH_NOTES.md")
-                return
+            existing = fh.read()
+        if not on_tpu and "on_tpu=True" in existing:
+            # never clobber a real-TPU sweep with CPU-fallback numbers
+            _note("bench: keeping existing TPU BENCH_NOTES.md")
+            return
+        # durable hand-written context (methodology caveats, operating-
+        # point history) survives the auto-rewrite: everything from the
+        # '## Hand notes' heading down is carried over verbatim
+        marker = existing.find("## Hand notes")
+        if marker >= 0:
+            hand_notes = existing[marker:].rstrip()
     try:
         lines = ["# BENCH notes (auto-written by bench.py)", "",
                  f"- device: {kind} x{n_chips} (on_tpu={on_tpu})",
                  f"- chosen operating point: dtype={best['dtype']} "
                  f"batch={best['batch']} remat={best['remat']} -> "
                  f"{best['clips_per_sec_per_chip']} clips/sec/chip",
-                 "", "| dtype | batch | remat | s2d | conv | loss | ga | step_ms | clips/s/chip | MFU |",
-                 "|---|---|---|---|---|---|---|---|---|---|"]
+                 "", "| dtype | batch | remat | s2d | conv | map | loss | ga | step_ms | clips/s/chip | MFU |",
+                 "|---|---|---|---|---|---|---|---|---|---|---|"]
         for r in results:
+            clips = str(r["clips_per_sec_per_chip"])
+            if r.get("cliff_vs_smaller_batch"):
+                clips += (f" (cliff: -{100 * r['cliff_vs_smaller_batch']:.0f}"
+                          "% vs smaller batch)")
             lines.append(f"| {r['dtype']} | {r['batch']} | {r['remat']} | "
                          f"{r.get('s2d', False)} | "
                          f"{r.get('conv_impl', 'native')} | "
+                         f"{'tuned' if r.get('impl_map') else '-'} | "
                          f"{r.get('loss', 'milnce')} | "
                          f"{r.get('grad_accum', 1)} | "
-                         f"{r['step_ms']} | {r['clips_per_sec_per_chip']} | "
+                         f"{r['step_ms']} | {clips} | "
                          f"{r.get('mfu', '-')} |")
+        maps = sorted({r["impl_map"] for r in results if r.get("impl_map")})
+        if maps:
+            lines += ["", "Per-stage impl map for 'tuned' rows: "
+                      + "; ".join(f"`{m}`" for m in maps)
+                      + " (stage_probe --autotune artifact / inline spec)."]
+        if any(r.get("cliff_vs_smaller_batch") for r in results):
+            lines += ["", "Rows marked 'cliff' regress >10% clips/s vs a "
+                      "SMALLER batch — a padded-batch/tiling cliff, not "
+                      "the usual diminishing-returns knee (PERF.md "
+                      "'Batch cliffs')."]
         if truncated:
             lines += ["", "**SWEEP TRUNCATED**: the TPU tunnel wedged "
                       "mid-sweep; rows above are what was measured "
                       "before it died."]
         lines += ["", "Roofline context for these numbers: PERF.md "
                   "(analytic per-stage FLOPs/bytes/intensity model)."]
+        if hand_notes:
+            lines += ["", hand_notes]
         with open(os.path.join(_REPO, "BENCH_NOTES.md"), "w") as fh:
             fh.write("\n".join(lines) + "\n")
     except Exception as exc:

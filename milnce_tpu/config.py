@@ -69,10 +69,61 @@ class ModelConfig:
     dtype: str = "float32"              # activation dtype ('bfloat16' for MXU speed)
     conv_impl: str = "native"           # 'native' 3D convs | 'fold2d' (same
                                         # math as 2D convs — layout XLA:TPU's
-                                        # conv emitter is tuned for; see
-                                        # models/conv3d.py, identical params)
+                                        # conv emitter is tuned for) |
+                                        # 'im2col' (patches + one dot_general;
+                                        # see models/conv3d.py, identical
+                                        # params under all three)
+    conv_impl_map: str = ""             # PER-STAGE impl override on top of
+                                        # conv_impl: inline
+                                        # 'conv1=im2col,mixed_3b=fold2d' or a
+                                        # path to the autotune artifact
+                                        # scripts/stage_probe.py --autotune
+                                        # writes (JSON with an 'impl_map'
+                                        # key); stages not named fall back to
+                                        # conv_impl.  '' = uniform conv_impl.
     remat: bool = False                 # rematerialize Inception blocks
                                         # (jax.checkpoint) to fit big batches
+
+
+CONV_IMPLS = ("native", "fold2d", "im2col")        # models/conv3d.py
+# Stage names an impl map may address — the granularity the stage probe
+# measures at (scripts/stage_probe.py; mirrors models/s3dg.py setup).
+CONV_STAGES = ("conv1", "conv_2b", "conv_2c",
+               "mixed_3b", "mixed_3c", "mixed_4b", "mixed_4c", "mixed_4d",
+               "mixed_4e", "mixed_4f", "mixed_5b", "mixed_5c")
+
+
+def parse_conv_impl_map(spec: str) -> dict:
+    """ModelConfig.conv_impl_map -> {stage: impl}.
+
+    Accepts '' (empty map), an inline 'stage=impl[,stage=impl...]' spec,
+    or a path to a JSON file — either a raw map or the autotune artifact
+    (``scripts/stage_probe.py --autotune``), whose map lives under the
+    'impl_map' key.  Unknown stages or impls raise ValueError so a typo
+    fails at config time, not as a silently-ignored key."""
+    if not spec:
+        return {}
+    if "=" in spec:
+        items = [item for item in spec.split(",") if item]
+        bad = [item for item in items if "=" not in item]
+        if bad:
+            raise ValueError(f"impl map items missing '=': {bad} "
+                             "(inline form is 'stage=impl[,stage=impl...]')")
+        mapping = dict(item.split("=", 1) for item in items)
+    else:
+        import json
+
+        with open(spec) as fh:
+            payload = json.load(fh)
+        mapping = payload.get("impl_map", payload)
+    for stage, impl in mapping.items():
+        if stage not in CONV_STAGES:
+            raise ValueError(f"impl map names unknown stage {stage!r} "
+                             f"(stages: {', '.join(CONV_STAGES)})")
+        if impl not in CONV_IMPLS:
+            raise ValueError(f"impl map stage {stage!r} names unknown impl "
+                             f"{impl!r} (impls: {', '.join(CONV_IMPLS)})")
+    return dict(mapping)
 
 
 @dataclass

@@ -12,7 +12,7 @@ import os
 import jax.numpy as jnp
 import numpy as np
 
-from milnce_tpu.config import ModelConfig
+from milnce_tpu.config import ModelConfig, parse_conv_impl_map
 from milnce_tpu.models.s3dg import S3D
 from milnce_tpu.models.text import word2vec_embedding_init
 
@@ -49,6 +49,10 @@ def build_model(cfg: ModelConfig, bn_axis_name: str | None = None) -> S3D:
         weight_init=cfg.weight_init,
         bn_axis_name=bn_axis_name if cfg.sync_batchnorm else None,
         conv_impl=cfg.conv_impl,
+        # hashable form (tuple of pairs) so the module stays usable as a
+        # static jit argument; S3D turns it back into a lookup
+        conv_impl_map=tuple(sorted(
+            parse_conv_impl_map(cfg.conv_impl_map).items())) or None,
         embedding_init=embedding_init,
         remat=cfg.remat,
         dtype=jnp.dtype(cfg.dtype),

@@ -15,15 +15,34 @@ how they reach the MXU:
   kernels fold (H,W) into one spatial dim ((B,T,H*W,C)), and a full
   (kt,kh,kw) kernel decomposes into kt temporally-shifted 2D convs
   summed (valid because conv is linear in the kernel taps).
+- ``"im2col"``: bypass the conv emitter entirely — extract every
+  receptive-field patch into a ``(B, T', H', W', kt*kh*kw*Cin)`` tensor
+  and hit the MXU with ONE ``dot_general``, the op XLA:TPU tiles best.
+  Built for the two non-separable stem convs (3x7x7 stride-2 conv1 runs
+  at 1% of peak under the native lowering, 102x over its roofline bound
+  — STAGE_PROBE_native_fwdbwd.md): their tiny 3/24-channel input gives
+  the conv tiler nothing to put on the 128-wide MXU lanes, while the
+  im2col contraction dim (441*3 = 1323 for conv1) fills them.  A custom
+  VJP keeps the BACKWARD in matmul form too (PERF.md puts the step's
+  backward near 13% MFU, so a forward-only fix is half the win):
+  dW = patches(x)^T @ dY and dX = patches(dilate(dY)) @ flip(W)^T — the
+  standard conv-transpose-as-conv identity, expressed as im2col again.
+  Cost: the patch tensor materializes prod(k)/prod(s) x the input
+  (~55x for conv1) in HBM; conv1's activations are small enough that
+  this stays ~0.5 GB/clip-batch-32 in bf16, but it is why im2col is a
+  per-STAGE choice, not a global one — deep trunk stages with big C
+  would blow HBM for no tiling gain.
 
 The parameter is a single ``kernel`` of shape ``(t, h, w, in, out)``
-in BOTH impls, so checkpoints swap freely and the flag is purely a
-performance choice (``scripts/stage_probe.py --conv_impl`` measures it
-per stage).
+in ALL impls, so checkpoints swap freely and the flag is purely a
+performance choice (``scripts/stage_probe.py --conv_impl`` measures one
+impl per stage; ``--autotune`` measures all three and emits the winning
+per-stage map).
 """
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Callable, Sequence, Tuple
 
 import jax
@@ -37,6 +56,96 @@ Array = jax.Array
 _DN3D = ("NDHWC", "DHWIO", "NDHWC")
 _DN2D = ("NHWC", "HWIO", "NHWC")
 
+IMPLS = ("native", "fold2d", "im2col")
+
+
+def _extract_patches(x: Array, ksize: Tuple[int, int, int],
+                     strides: Tuple[int, int, int],
+                     pads) -> Array:
+    """(B,T,H,W,C) -> (B,T',H',W', kt*kh*kw*C) patch tensor.
+
+    ``pads`` is one (lo, hi) pair per spatial dim (the backward's
+    transposed conv needs asymmetric padding).  Tap order along the last
+    axis is (dt, dh, dw, c) — exactly ``kernel.reshape(-1, features)``'s
+    row order, so the caller can contract with one reshaped matmul.
+    The taps are strided slices of ONE padded array; XLA fuses them into
+    the dot operand's loads rather than 147 separate copies.
+    """
+    kt, kh, kw = ksize
+    st, sh, sw = strides
+    b, _, _, _, c = x.shape
+    xp = jnp.pad(x, ((0, 0),) + tuple(pads) + ((0, 0),))
+    outs = [(xp.shape[i + 1] - k) // s + 1
+            for i, (k, s) in enumerate(zip(ksize, strides))]
+    to, ho, wo = outs
+    taps = []
+    for dt in range(kt):
+        for dh in range(kh):
+            for dw in range(kw):
+                taps.append(lax.slice(
+                    xp,
+                    (0, dt, dh, dw, 0),
+                    (b, dt + st * (to - 1) + 1, dh + sh * (ho - 1) + 1,
+                     dw + sw * (wo - 1) + 1, c),
+                    (1, st, sh, sw, 1)))
+    return jnp.concatenate(taps, axis=-1)
+
+
+def _patch_matmul(patches: Array, kernel_mat: Array) -> Array:
+    """(B,T',H',W',K) x (K,F) -> (B,T',H',W',F): the one large MXU dot."""
+    return lax.dot_general(patches, kernel_mat, (((4,), (0,)), ((), ())))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _im2col_conv(x: Array, kernel: Array, strides: Tuple[int, int, int],
+                 padding: Tuple[int, int, int]) -> Array:
+    kt, kh, kw, ci, co = kernel.shape
+    patches = _extract_patches(x, (kt, kh, kw), strides,
+                               [(p, p) for p in padding])
+    return _patch_matmul(patches, kernel.reshape(kt * kh * kw * ci, co))
+
+
+def _im2col_fwd(x, kernel, strides, padding):
+    # residual is (x, kernel), NOT the patch tensor: patches are
+    # prod(k)/prod(s) x the input and recomputing them in the backward
+    # (pure data movement) is far cheaper than holding them across the
+    # whole step.
+    return _im2col_conv(x, kernel, strides, padding), (x, kernel)
+
+
+def _im2col_bwd(strides, padding, res, g):
+    x, kernel = res
+    kt, kh, kw, ci, co = kernel.shape
+    st, sh, sw = strides
+    b = x.shape[0]
+    to, ho, wo = g.shape[1:4]
+
+    # dW: contract the recomputed patches against dY over every output
+    # position — one (K, B*S) x (B*S, F) matmul.
+    patches = _extract_patches(x, (kt, kh, kw), strides,
+                               [(p, p) for p in padding])
+    dw = lax.dot_general(patches, g,
+                         (((0, 1, 2, 3), (0, 1, 2, 3)), ((), ())))
+    dw = dw.reshape(kt, kh, kw, ci, co).astype(kernel.dtype)
+
+    # dX: the transposed conv, AS im2col — dilate dY by the stride,
+    # pad (k-1-p) low / (in_size + p - dilated_size) high, then one
+    # matmul against the spatially-flipped, in/out-transposed kernel.
+    # (pad_hi >= 0 always: p <= k-1 for every trunk conv, and it absorbs
+    # input columns a non-dividing stride never touched.)
+    dil = (st * (to - 1) + 1, sh * (ho - 1) + 1, sw * (wo - 1) + 1)
+    gd = jnp.zeros((b,) + dil + (co,), g.dtype)
+    gd = gd.at[:, ::st, ::sh, ::sw].set(g)
+    pads = [(k - 1 - p, size + p - d)
+            for k, p, size, d in zip((kt, kh, kw), padding, x.shape[1:4], dil)]
+    gpatches = _extract_patches(gd, (kt, kh, kw), (1, 1, 1), pads)
+    wflip = kernel[::-1, ::-1, ::-1].transpose(0, 1, 2, 4, 3)
+    dx = _patch_matmul(gpatches, wflip.reshape(kt * kh * kw * co, ci))
+    return dx.astype(x.dtype), dw
+
+
+_im2col_conv.defvjp(_im2col_fwd, _im2col_bwd)
+
 
 class Conv3D(nn.Module):
     """Bias-free 3D conv with explicit symmetric padding per dim,
@@ -46,7 +155,7 @@ class Conv3D(nn.Module):
     kernel_size: Sequence[int]
     strides: Sequence[int] = (1, 1, 1)
     padding: Sequence[int] = (0, 0, 0)
-    impl: str = "native"                  # 'native' | 'fold2d'
+    impl: str = "native"                  # 'native' | 'fold2d' | 'im2col'
     kernel_init: Callable = nn.initializers.lecun_normal()
     dtype: Any = jnp.float32
 
@@ -64,6 +173,8 @@ class Conv3D(nn.Module):
             return lax.conv_general_dilated(
                 x, kernel, (st, sh, sw), [(pt, pt), (ph, ph), (pw, pw)],
                 dimension_numbers=_DN3D)
+        if self.impl == "im2col":
+            return _im2col_conv(x, kernel, (st, sh, sw), (pt, ph, pw))
         if self.impl != "fold2d":
             raise ValueError(f"unknown conv impl {self.impl!r}")
 

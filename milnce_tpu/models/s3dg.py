@@ -237,8 +237,16 @@ class S3D(nn.Module):
     text_hidden_dim: int = 2048
     weight_init: str = "uniform"
     bn_axis_name: Optional[str] = None
-    conv_impl: str = "native"           # 'native' 3D convs | 'fold2d'
-                                        # (see models/conv3d.py)
+    conv_impl: str = "native"           # 'native' 3D convs | 'fold2d' |
+                                        # 'im2col' (see models/conv3d.py)
+    conv_impl_map: Optional[Tuple[Tuple[str, str], ...]] = None
+                                        # per-stage (stage, impl) overrides at
+                                        # probe granularity (conv1, conv_2b,
+                                        # conv_2c, mixed_*) — tuple of pairs,
+                                        # not a dict, so the module stays
+                                        # hashable; unnamed stages use
+                                        # conv_impl.  build_model constructs
+                                        # it from ModelConfig.conv_impl_map.
     embedding_init: Optional[Callable] = None
     remat: bool = False                 # rematerialize Inception blocks to
                                         # trade FLOPs for HBM at big batches
@@ -248,41 +256,61 @@ class S3D(nn.Module):
         assert 1 <= self.inception_blocks <= 9, (
             f"inception_blocks must be in [1, 9], got {self.inception_blocks}")
         ki = kernel_init_for(self.weight_init)
+        # per-stage impl resolution: the map (autotune output) wins over
+        # the uniform conv_impl for the stages it names
+        impl_map = dict(self.conv_impl_map or ())
+
+        def impl(stage: str) -> str:
+            return impl_map.get(stage, self.conv_impl)
+
         common = dict(bn_axis_name=self.bn_axis_name, kernel_init=ki,
-                      conv_impl=self.conv_impl, dtype=self.dtype)
+                      dtype=self.dtype)
         block_cls = (nn.remat(InceptionBlock, static_argnums=(2,))
                      if self.remat else InceptionBlock)
         if self.use_space_to_depth:
             # reference s3dg.py:215 (+ the post-conv crop in forward_video)
             self.conv1 = STConv3D(64, (2, 4, 4), strides=1, padding=(1, 2, 2),
-                                  name="conv1", **common)
+                                  conv_impl=impl("conv1"), name="conv1",
+                                  **common)
         else:
             # reference s3dg.py:217
             self.conv1 = STConv3D(64, (3, 7, 7), strides=2, padding=(1, 3, 3),
-                                  name="conv1", **common)
-        self.conv_2b = STConv3D(64, (1, 1, 1), name="conv_2b", **common)
+                                  conv_impl=impl("conv1"), name="conv1",
+                                  **common)
+        self.conv_2b = STConv3D(64, (1, 1, 1), conv_impl=impl("conv_2b"),
+                                name="conv_2b", **common)
         self.conv_2c = STConv3D(192, (3, 3, 3), padding=1, separable=True,
-                                name="conv_2c", **common)
+                                conv_impl=impl("conv_2c"), name="conv_2c",
+                                **common)
         self.stem_gating = SelfGating(ki, self.dtype, name="gating")
         blocks = dict(gating=self.gating, **common)
         self.mixed_3b = block_cls(64, 96, 128, 16, 32, 32,
-                                       name="mixed_3b", **blocks)
+                                  conv_impl=impl("mixed_3b"),
+                                  name="mixed_3b", **blocks)
         self.mixed_3c = block_cls(128, 128, 192, 32, 96, 64,
-                                       name="mixed_3c", **blocks)
+                                  conv_impl=impl("mixed_3c"),
+                                  name="mixed_3c", **blocks)
         self.mixed_4b = block_cls(192, 96, 208, 16, 48, 64,
-                                       name="mixed_4b", **blocks)
+                                  conv_impl=impl("mixed_4b"),
+                                  name="mixed_4b", **blocks)
         self.mixed_4c = block_cls(160, 112, 224, 24, 64, 64,
-                                       name="mixed_4c", **blocks)
+                                  conv_impl=impl("mixed_4c"),
+                                  name="mixed_4c", **blocks)
         self.mixed_4d = block_cls(128, 128, 256, 24, 64, 64,
-                                       name="mixed_4d", **blocks)
+                                  conv_impl=impl("mixed_4d"),
+                                  name="mixed_4d", **blocks)
         self.mixed_4e = block_cls(112, 144, 288, 32, 64, 64,
-                                       name="mixed_4e", **blocks)
+                                  conv_impl=impl("mixed_4e"),
+                                  name="mixed_4e", **blocks)
         self.mixed_4f = block_cls(256, 160, 320, 32, 128, 128,
-                                       name="mixed_4f", **blocks)
+                                  conv_impl=impl("mixed_4f"),
+                                  name="mixed_4f", **blocks)
         self.mixed_5b = block_cls(256, 160, 320, 32, 128, 128,
-                                       name="mixed_5b", **blocks)
+                                  conv_impl=impl("mixed_5b"),
+                                  name="mixed_5b", **blocks)
         self.mixed_5c = block_cls(384, 192, 384, 48, 128, 128,
-                                       name="mixed_5c", **blocks)
+                                  conv_impl=impl("mixed_5c"),
+                                  name="mixed_5c", **blocks)
         # Linear layers stay at torch defaults in both init modes
         # (s3dg.py:240-246 re-inits only convs/BN); fan-in = output dim of
         # the last active block (1024 for the full mixed_5c trunk).

@@ -19,8 +19,24 @@ not physics.
     python scripts/stage_probe.py --batch 128 --dtype bfloat16
     MILNCE_PROFILE_CPU=1 python scripts/stage_probe.py --batch 2 --size 64
 
+``--autotune`` turns the probe into a per-stage impl SELECTOR: every
+conv stage is timed under each lowering in ``--impls`` (native, fold2d,
+im2col — models/conv3d.py) for each mode in ``--modes`` (fwd, fwdbwd),
+the winner per stage is the one with the lowest fwd+bwd time (the
+training cost; PERF.md puts the backward near 13% MFU, so a
+forward-picked winner could still lose the step), and the winning map
+is written as a JSON artifact (``--out``, default build/impl_map.json)
+that ``ModelConfig.conv_impl_map``, ``bench.py``
+(MILNCE_BENCH_IMPL_MAP) and ``scripts/xla_flag_probe.py`` all consume:
+
+    python scripts/stage_probe.py --autotune
+    MILNCE_PROFILE_CPU=1 python scripts/stage_probe.py --autotune \
+        --batch 2 --frames 4 --size 32 --stages conv1 --iters 2
+
 Writes one JSON line per stage to stdout and a summary table to
-``STAGE_PROBE.md`` (TPU runs only).
+``STAGE_PROBE.md`` / ``STAGE_AUTOTUNE.md`` (TPU runs only; a CPU sanity
+run must never clobber a real-chip artifact).  The autotune JSON
+artifact is written on every platform — it records its device honestly.
 """
 
 from __future__ import annotations
@@ -50,6 +66,22 @@ _HBM_BW = {
 }
 
 
+def _validate_stage_filter(stages_csv: str) -> set:
+    """--stages value -> set of conv stage names; a typo must fail HERE,
+    not silently autotune zero stages and ship an empty map marked
+    complete (config.parse_conv_impl_map guards the consume side; this
+    guards the produce side)."""
+    from milnce_tpu.config import CONV_STAGES
+
+    only = {s for s in stages_csv.split(",") if s}
+    unknown = only - set(CONV_STAGES)
+    if unknown:
+        raise ValueError(
+            f"--stages names unknown conv stage(s) {sorted(unknown)} "
+            f"(stages: {', '.join(CONV_STAGES)})")
+    return only
+
+
 def _hbm_bandwidth(device_kind: str) -> float:
     kind = device_kind.lower()
     for key, val in _HBM_BW.items():
@@ -68,25 +100,94 @@ def _timed(fn, x, n_iters: int) -> float:
     return chained_seconds(lambda d: jnp.sum(fn(d)), x, n_iters, k1=2)
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--batch", type=int, default=32)
-    ap.add_argument("--frames", type=int, default=16)
-    ap.add_argument("--size", type=int, default=224)
-    ap.add_argument("--dtype", default="bfloat16")
-    ap.add_argument("--conv_impl", default="native",
-                    choices=["native", "fold2d"])
-    ap.add_argument("--iters", type=int, default=8,
-                    help="chained executions per measurement")
-    ap.add_argument("--mode", default="fwd", choices=["fwd", "fwdbwd"],
-                    help="fwdbwd also differentiates each stage w.r.t. "
-                         "its params AND input — the training cost.  The "
-                         "backward is ~2/3 of a train step's FLOPs and "
-                         "grad-conv lowerings tile differently from the "
-                         "forward, so a stage at its forward roofline can "
-                         "still be the step's MFU sink")
-    args = ap.parse_args()
+def _stage_fns(model, variables, method, mode: str):
+    """(fwd, probe) for one stage method of ``model``: probe is the
+    forward in 'fwd' mode, or the fwd+bwd scalar (grads w.r.t. params
+    AND input — what training pays at this stage) in 'fwdbwd' mode."""
+    import jax
+    import jax.numpy as jnp
 
+    def fwd(x):
+        return model.apply(variables, x, method=method)
+
+    if mode == "fwd":
+        return fwd, fwd
+
+    def fwdbwd(x):
+        # Both grads fold into one scalar so neither is DCE'd.  Only the
+        # 'params' collection is differentiated (batch_stats and friends
+        # stay closed over); grads of params the stage doesn't touch are
+        # constant zeros XLA folds away, costing trace size, not runtime.
+        rest = {k: v for k, v in variables.items() if k != "params"}
+
+        def loss(p, xx):
+            return jnp.sum(
+                model.apply({"params": p, **rest}, xx, method=method)
+                .astype(jnp.float32))
+
+        dp, dx = jax.grad(loss, argnums=(0, 1))(variables["params"], x)
+        acc = jnp.sum(dx.astype(jnp.float32))
+        for leaf in jax.tree_util.tree_leaves(dp):
+            acc = acc + jnp.sum(leaf.astype(jnp.float32))
+        return acc
+
+    return fwd, fwdbwd
+
+
+def _build_stages(model, variables, mode: str):
+    """The trunk as (name, (fwd, probe), pool_before, is_conv) tuples,
+    in forward order — shared by the single-impl probe and the
+    autotuner."""
+    import jax
+    import jax.numpy as jnp
+
+    from milnce_tpu.models.s3dg import _tf_same_max_pool
+    from milnce_tpu.utils import roofline
+
+    def stage(method):
+        return _stage_fns(model, variables, method, mode)
+
+    def block_stage(name):
+        def method(m, x):
+            return getattr(m, name)(x, False)
+
+        return stage(method)
+
+    def pool_stage(window, strides):
+        def fwd(x):
+            return _tf_same_max_pool(x, window, strides)
+
+        if mode == "fwd":
+            return fwd, fwd
+        return fwd, jax.grad(lambda x: jnp.sum(fwd(x).astype(jnp.float32)))
+
+    stages = [
+        ("conv1", stage(lambda m, x: m.conv1(x, False)), None, True),
+        ("maxpool_2a", pool_stage((1, 3, 3), (1, 2, 2)), None, False),
+        ("conv_2b", stage(lambda m, x: m.conv_2b(x, False)), None, True),
+        ("conv_2c", stage(lambda m, x: m.conv_2c(x, False)), None, True),
+        ("gating", stage(lambda m, x: m.stem_gating(x)), None, False),
+        ("maxpool_3a", pool_stage((1, 3, 3), (1, 2, 2)), None, False),
+    ]
+    for idx, (name, _) in enumerate(roofline.INCEPTION_PLAN):
+        stages.append((name, block_stage(name),
+                       roofline.POOLS_BEFORE.get(idx), True))
+    return stages
+
+
+def _init_jitted(model, frames: int, size: int):
+    """jit the init: eager Flax init dispatches every parameter's RNG +
+    op individually — multi-second per-dispatch latency over the axon
+    tunnel turns that into tens of minutes (bench.py learned the same)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.jit(lambda key: model.init(
+        key, jnp.zeros((2, frames, size, size, 3), jnp.float32),
+        jnp.zeros((2, 6), jnp.int32)))(jax.random.PRNGKey(0))
+
+
+def _setup_backend(args):
     if os.environ.get("MILNCE_PROFILE_CPU") == "1":
         import jax
 
@@ -97,11 +198,73 @@ def main() -> None:
         sys.exit(1)
 
     import jax
-    import jax.numpy as jnp
 
     jax.config.update("jax_compilation_cache_dir",
                       os.path.join(_REPO, "build", "jax_cache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    dev_kind = getattr(jax.devices()[0], "device_kind",
+                       jax.devices()[0].platform)
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    return str(dev_kind), on_tpu
+
+
+def _device_input_fn(args, compute_dtype):
+    """Synthetic input generated ON DEVICE: shipping host-generated
+    video over the tunnel costs more than the measurement.  One jitted
+    generator reused for all seeds (a fresh lambda per call would miss
+    the jit trace cache and recompile over the tunnel)."""
+    import jax
+    import jax.numpy as jnp
+
+    gen = jax.jit(lambda key: jax.random.uniform(
+        key, (args.batch, args.frames, args.size, args.size, 3),
+        jnp.float32).astype(compute_dtype))
+    return lambda seed: gen(jax.random.PRNGKey(seed))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--frames", type=int, default=16)
+    ap.add_argument("--size", type=int, default=224)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--conv_impl", default="native",
+                    choices=["native", "fold2d", "im2col"])
+    ap.add_argument("--iters", type=int, default=8,
+                    help="chained executions per measurement")
+    ap.add_argument("--mode", default="fwd", choices=["fwd", "fwdbwd"],
+                    help="fwdbwd also differentiates each stage w.r.t. "
+                         "its params AND input — the training cost.  The "
+                         "backward is ~2/3 of a train step's FLOPs and "
+                         "grad-conv lowerings tile differently from the "
+                         "forward, so a stage at its forward roofline can "
+                         "still be the step's MFU sink")
+    ap.add_argument("--autotune", action="store_true",
+                    help="time every conv stage under each impl in "
+                         "--impls and emit the winning per-stage map "
+                         "(see --out)")
+    ap.add_argument("--impls", default="native,fold2d,im2col",
+                    help="autotune candidates, comma-separated")
+    ap.add_argument("--modes", default="fwd,fwdbwd",
+                    help="autotune measurement modes; the LAST one "
+                         "listed picks the winner (fwdbwd = training "
+                         "cost, the default tiebreak)")
+    ap.add_argument("--stages", default="",
+                    help="autotune only these conv stages (comma list; "
+                         "'' = all) — the CPU smoke path")
+    ap.add_argument("--out", default=os.path.join("build", "impl_map.json"),
+                    help="autotune artifact path (repo-relative)")
+    args = ap.parse_args()
+
+    if args.autotune:
+        autotune(args)
+        return
+
+    dev_kind, on_tpu = _setup_backend(args)
+
+    import jax
+    import jax.numpy as jnp
 
     from milnce_tpu.config import full_preset
     from milnce_tpu.models.build import build_model
@@ -112,85 +275,17 @@ def main() -> None:
     cfg.model.dtype = args.dtype
     cfg.model.conv_impl = args.conv_impl
     model = build_model(cfg.model)
-    # jit the init: eager Flax init dispatches every parameter's RNG +
-    # op individually — multi-second per-dispatch latency over the axon
-    # tunnel turns that into tens of minutes (bench.py learned the same)
-    variables = jax.jit(lambda key: model.init(
-        key, jnp.zeros((2, args.frames, args.size, args.size, 3),
-                       jnp.float32),
-        jnp.zeros((2, 6), jnp.int32)))(jax.random.PRNGKey(0))
+    variables = _init_jitted(model, args.frames, args.size)
 
-    dev_kind = getattr(jax.devices()[0], "device_kind",
-                       jax.devices()[0].platform)
-    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     # peak flops / HBM GB/s for the roofline bound (bench.py table)
     from bench import _PEAK_FLOPS, _peak_flops
 
-    peak_flops = _peak_flops(str(dev_kind)) or max(_PEAK_FLOPS.values())
-    hbm_gbs = (_hbm_bandwidth(str(dev_kind)) if on_tpu
-               else 50e9)                          # CPU ~DDR
+    peak_flops = _peak_flops(dev_kind) or max(_PEAK_FLOPS.values())
+    hbm_gbs = _hbm_bandwidth(dev_kind) if on_tpu else 50e9     # CPU ~DDR
 
     compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
 
-    def stage_apply(method):
-        def fwd(x):
-            return model.apply(variables, x, method=method)
-
-        if args.mode == "fwd":
-            return fwd, fwd
-
-        def fwdbwd(x):
-            # grads w.r.t. params AND input — what training pays at this
-            # stage.  Both grads fold into one scalar so neither is
-            # DCE'd.  Only the 'params' collection is differentiated
-            # (batch_stats and friends stay closed over); grads of
-            # params the stage doesn't touch are constant zeros XLA
-            # folds away, costing trace size, not runtime.
-            rest = {k: v for k, v in variables.items() if k != "params"}
-
-            def loss(p, xx):
-                return jnp.sum(
-                    model.apply({"params": p, **rest}, xx, method=method)
-                    .astype(jnp.float32))
-
-            dp, dx = jax.grad(loss, argnums=(0, 1))(variables["params"], x)
-            acc = jnp.sum(dx.astype(jnp.float32))
-            for leaf in jax.tree_util.tree_leaves(dp):
-                acc = acc + jnp.sum(leaf.astype(jnp.float32))
-            return acc
-
-        return fwd, fwdbwd
-
-    block_names = [n for n, _ in roofline.INCEPTION_PLAN]
-
-    def block_stage(name):
-        def method(m, x):
-            return getattr(m, name)(x, False)
-
-        return stage_apply(method)
-
-    # (stage name, (fwd, probe) fns, pool applied to the input first)
-    def pool_stage(window, strides):
-        def fwd(x):
-            return _tf_same_max_pool(x, window, strides)
-
-        if args.mode == "fwd":
-            return fwd, fwd
-        return fwd, jax.grad(lambda x: jnp.sum(fwd(x).astype(jnp.float32)))
-
-    stages = [
-        ("conv1", stage_apply(lambda m, x: m.conv1(x, False)), None),
-        ("maxpool_2a", pool_stage((1, 3, 3), (1, 2, 2)),
-         None),
-        ("conv_2b", stage_apply(lambda m, x: m.conv_2b(x, False)), None),
-        ("conv_2c", stage_apply(lambda m, x: m.conv_2c(x, False)), None),
-        ("gating", stage_apply(lambda m, x: m.stem_gating(x)), None),
-        ("maxpool_3a", pool_stage((1, 3, 3), (1, 2, 2)),
-         None),
-    ]
-    for idx, name in enumerate(block_names):
-        pool = roofline.POOLS_BEFORE.get(idx)
-        stages.append((name, block_stage(name), pool))
+    stages = _build_stages(model, variables, args.mode)
 
     # analytic per-stage roofline at this shape
     model_stages = roofline.s3d_video_stages(
@@ -203,22 +298,12 @@ def main() -> None:
         flops_by_prefix[prefix] = flops_by_prefix.get(prefix, 0.0) + st.flops
         bytes_by_prefix[prefix] = bytes_by_prefix.get(prefix, 0.0) + st.bytes
 
-    # synthetic input generated ON DEVICE: shipping host-generated video
-    # over the tunnel costs more than the measurement.  One jitted
-    # generator reused for both seeds (a fresh lambda per call would
-    # miss the jit trace cache and recompile over the tunnel).
-    _gen_input = jax.jit(lambda key: jax.random.uniform(
-        key, (args.batch, args.frames, args.size, args.size, 3),
-        jnp.float32).astype(compute_dtype))
-
-    def device_input(seed):
-        return _gen_input(jax.random.PRNGKey(seed))
-
+    device_input = _device_input_fn(args, compute_dtype)
     x = device_input(0)
 
     records = []
     total_ms = 0.0
-    for name, (fwd_fn, probe_fn), pool in stages:
+    for name, (fwd_fn, probe_fn), pool, _ in stages:
         if pool is not None:
             x = _tf_same_max_pool(x, *pool)
         t = _timed(probe_fn, x, args.iters)
@@ -257,8 +342,9 @@ def main() -> None:
 
     # whole-trunk forward for reconciliation (sum of parts vs one program:
     # the difference is what XLA's cross-stage fusion buys)
-    # stage_apply's second element is already the mode-appropriate probe
-    _, trunk_probe = stage_apply(lambda m, v: m.forward_video(v))
+    # _stage_fns's second element is already the mode-appropriate probe
+    _, trunk_probe = _stage_fns(model, variables,
+                                lambda m, v: m.forward_video(v), args.mode)
     x0 = device_input(1)
     t_trunk = _timed(trunk_probe, x0, args.iters)
     summary = {
@@ -267,7 +353,7 @@ def main() -> None:
         "mode": args.mode,
         "ms": round(t_trunk * 1e3, 3),
         "sum_of_stage_ms": round(total_ms, 3),
-        "device": str(dev_kind),
+        "device": dev_kind,
         "batch": args.batch,
         "dtype": args.dtype,
         "conv_impl": args.conv_impl,
@@ -277,6 +363,146 @@ def main() -> None:
 
     if on_tpu:
         _write_md(records, args)
+
+
+def autotune(args) -> None:
+    """Measure every conv stage under each candidate impl and emit the
+    winning per-stage map as a config artifact.
+
+    One model per impl, ONE shared parameter tree (the impls are
+    layout-identical by design — models/conv3d.py), stage inputs
+    advanced by the native forward so every impl times the same tensor.
+    """
+    from milnce_tpu.config import CONV_IMPLS
+
+    # validate BEFORE paying for a backend: a typo'd filter would
+    # otherwise autotune zero stages and ship an empty complete map
+    impls = [s for s in args.impls.split(",") if s]
+    modes = [s for s in args.modes.split(",") if s]
+    only = _validate_stage_filter(args.stages)
+    unknown = set(impls) - set(CONV_IMPLS)
+    if unknown:
+        raise ValueError(f"--impls names unknown impl(s) {sorted(unknown)} "
+                         f"(impls: {', '.join(CONV_IMPLS)})")
+    bad_modes = set(modes) - {"fwd", "fwdbwd"}
+    if bad_modes:
+        # _stage_fns treats anything non-'fwd' as fwdbwd; a typo'd mode
+        # would burn a chip session and mislabel the artifact
+        raise ValueError(f"--modes names unknown mode(s) {sorted(bad_modes)} "
+                         "(modes: fwd, fwdbwd)")
+
+    dev_kind, on_tpu = _setup_backend(args)
+
+    import jax
+    import jax.numpy as jnp
+
+    from milnce_tpu.config import full_preset
+    from milnce_tpu.models.build import build_model
+    from milnce_tpu.models.s3dg import _tf_same_max_pool
+
+    cfg = full_preset()
+    cfg.model.dtype = args.dtype
+    models = {}
+    for impl in impls:
+        cfg.model.conv_impl = impl
+        models[impl] = build_model(cfg.model)
+    variables = _init_jitted(models[impls[0]], args.frames, args.size)
+
+    compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    device_input = _device_input_fn(args, compute_dtype)
+    x = device_input(0)
+
+    # per-impl stage lists share the walk order; index them together
+    per_impl = {impl: {mode: _build_stages(models[impl], variables, mode)
+                       for mode in modes}
+                for impl in impls}
+    walk = per_impl[impls[0]][modes[0]]
+
+    results = {}                        # stage -> impl -> mode -> ms
+    impl_map = {}
+    for idx, (name, _, pool, is_conv) in enumerate(walk):
+        if pool is not None:
+            x = _tf_same_max_pool(x, *pool)
+        if is_conv and (not only or name in only):
+            timings = {}
+            for impl in impls:
+                timings[impl] = {}
+                for mode in modes:
+                    _, probe_fn = per_impl[impl][mode][idx][1]
+                    timings[impl][mode] = round(
+                        _timed(probe_fn, x, args.iters) * 1e3, 3)
+            # the LAST mode listed picks the winner (fwdbwd by default —
+            # the training cost)
+            decide = modes[-1]
+            winner = min(impls, key=lambda i: timings[i][decide])
+            results[name] = timings
+            if winner != "native":      # map only carries overrides
+                impl_map[name] = winner
+            print(json.dumps({"stage": name, "winner": winner,
+                              "by": decide, "ms": timings}), flush=True)
+            _write_artifact(results, impl_map, args, dev_kind)
+            if on_tpu:
+                _write_autotune_md(results, impl_map, args, dev_kind)
+        # advance via the FIRST impl's forward: all impls compute the
+        # same math, so the walk input is impl-independent
+        fwd_fn = per_impl[impls[0]][modes[0]][idx][1][0]
+        x = jax.jit(fwd_fn)(x)
+
+    _write_artifact(results, impl_map, args, dev_kind, final=True)
+    if on_tpu:
+        _write_autotune_md(results, impl_map, args, dev_kind)
+    print(json.dumps({"artifact": _artifact_path(args),
+                      "impl_map": impl_map}), flush=True)
+
+
+def _artifact_path(args) -> str:
+    out = args.out
+    return out if os.path.isabs(out) else os.path.join(_REPO, out)
+
+
+def _write_artifact(results, impl_map, args, dev_kind, final=False) -> None:
+    """Incrementally (re)write the autotune artifact — a mid-probe
+    tunnel wedge must not cost the stages already decided.  The map
+    feeds ModelConfig.conv_impl_map / bench.py MILNCE_BENCH_IMPL_MAP."""
+    path = _artifact_path(args)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    payload = {
+        "generator": "scripts/stage_probe.py --autotune",
+        "device": dev_kind,
+        "config": {"batch": args.batch, "frames": args.frames,
+                   "size": args.size, "dtype": args.dtype,
+                   "iters": args.iters, "modes": args.modes},
+        "complete": final,
+        "impl_map": impl_map,
+        "stage_ms": results,
+    }
+    with open(path, "w") as fh:
+        json.dump(payload, fh, indent=2)
+        fh.write("\n")
+
+
+def _write_autotune_md(results, impl_map, args, dev_kind) -> None:
+    modes = [s for s in args.modes.split(",") if s]
+    impls = [s for s in args.impls.split(",") if s]
+    lines = [
+        "# Stage impl autotune (auto-written by scripts/stage_probe.py"
+        " --autotune)", "",
+        f"- config: batch={args.batch} {args.frames}f@{args.size}^2 "
+        f"dtype={args.dtype} device={dev_kind}; winner per stage by "
+        f"{modes[-1]} ms (the training cost)",
+        f"- winning map (native omitted): "
+        f"`{json.dumps(impl_map, sort_keys=True)}` -> {args.out}",
+        "",
+        "| stage | " + " | ".join(f"{i} {m} ms" for i in impls
+                                  for m in modes) + " | winner |",
+        "|---" * (1 + len(impls) * len(modes) + 1) + "|",
+    ]
+    for stage, timings in results.items():
+        cells = [str(timings[i][m]) for i in impls for m in modes]
+        winner = impl_map.get(stage, "native")
+        lines.append(f"| {stage} | " + " | ".join(cells) + f" | {winner} |")
+    with open(os.path.join(_REPO, "STAGE_AUTOTUNE.md"), "w") as fh:
+        fh.write("\n".join(lines) + "\n")
 
 
 def _write_md(records, args) -> None:

@@ -1,16 +1,34 @@
 """XLA flag probe: re-measure the winning train-step operating point
 under candidate XLA:TPU flags.
 
-The measured MFU (18.1%, BENCH_NOTES.md) sits far under the analytic
+The measured MFU (18.2%, BENCH_NOTES.md) sits far under the analytic
 roofline ceiling (~63%, PERF.md) and the gap is scheduling/tiling —
 exactly the territory XLA flags move.  Each candidate flag set runs in
 its own watchdogged bench config child (bench._run_config: fresh
 process, own tunnel client, TERM-first stop), so a flag that wedges the
 compiler costs one timeout, and a flag the compiler rejects surfaces as
-a tagged error row, not a crash.
+a tagged error row WITH the child's stderr, not a crash.
+
+Round-5 lesson (XLA_FLAGS_PROBE.md): every non-baseline row died
+``rc=1, no record`` because the ``--xla_tpu_*`` knobs went into
+``XLA_FLAGS``, which the CLIENT-side XLA flag parser also reads — and
+it hard-aborts the process on any flag its own build doesn't know
+(the TPU-compiler knobs live in libtpu, not the client).  The fix is a
+flag ROUTER (:func:`split_flags`): ``--xla_tpu_*`` candidates ride
+``LIBTPU_INIT_ARGS`` (the TPU runtime's own flag channel), everything
+else stays in ``XLA_FLAGS``; both are restored after every row, and the
+child's stderr is captured into the report either way so the next
+failure diagnoses itself.
+
+The grid crosses the flag candidates with the winning stem lowering
+when an autotune artifact exists (``scripts/stage_probe.py --autotune``
+-> build/impl_map.json, or ``--impl_map``): the scoped-vmem limit is
+exactly the knob that decides how big a tile the one large im2col
+dot_general gets, so the two must be measured together.
 
     python scripts/xla_flag_probe.py                 # bf16 batch 128
     python scripts/xla_flag_probe.py --batch 64 --timeout 600
+    MILNCE_FLAGPROBE_CPU=1 python scripts/xla_flag_probe.py   # smoke
 
 Writes one JSON line per flag set to stdout and (TPU runs only)
 XLA_FLAGS_PROBE.md, incrementally — a mid-probe tunnel wedge keeps the
@@ -35,8 +53,9 @@ import bench  # noqa: E402
 # conv workload; collectives-oriented flags are pointless on one chip.
 CANDIDATES = [
     ("baseline", ""),
-    # more scoped VMEM lets the conv emitter pick bigger tiles (the
-    # small-temporal-dim stages are exactly the ones starved for tile)
+    # more scoped VMEM lets the conv emitter / dot tiler pick bigger
+    # tiles (the small-temporal-dim stages are exactly the ones starved
+    # for tile)
     ("vmem_64m", "--xla_tpu_scoped_vmem_limit_kib=65536"),
     ("vmem_128m", "--xla_tpu_scoped_vmem_limit_kib=131072"),
     # overlap-oriented scheduler; mostly collectives but also reorders
@@ -47,6 +66,76 @@ CANDIDATES = [
      "--xla_tpu_enable_latency_hiding_scheduler=true"),
 ]
 
+# CPU smoke grid: the TPU knobs above would be rejected by the CPU
+# client's flag parser (the exact round-5 failure this probe now
+# guards against), so the smoke exercises the same launcher/env
+# plumbing with flags the host XLA build does know.
+CPU_CANDIDATES = [
+    ("baseline", ""),
+    ("host_devices_2", "--xla_force_host_platform_device_count=2"),
+]
+
+
+def split_flags(flags: str) -> tuple[str, str]:
+    """Route one candidate set: (xla_flags_part, libtpu_part).
+
+    ``--xla_tpu_*`` knobs are TPU-compiler flags parsed by libtpu; fed
+    to the client's XLA_FLAGS parser they abort the process before jax
+    even initializes (rc=1, no record — the round-5 row killer)."""
+    tpu, generic = [], []
+    for tok in flags.split():
+        (tpu if tok.startswith("--xla_tpu_") else generic).append(tok)
+    return " ".join(generic), " ".join(tpu)
+
+
+def build_grid(cpu: bool, stem_impl_map: str) -> list:
+    """(name, flags, extra _run_config kwargs) rows.
+
+    When a winning stem lowering is known (autotune artifact or inline
+    spec), it is crossed with the baseline and the two flag sets that
+    interact with the big-matmul stem (scoped VMEM sizes the dot tiles;
+    the latency-hiding scheduler reorders the copies around them)."""
+    base = CPU_CANDIDATES if cpu else CANDIDATES
+    grid = [(name, flags, {}) for name, flags in base]
+    if stem_impl_map:
+        extra = {"conv_impl_map": stem_impl_map}
+        cross = ([("", "")] if cpu else
+                 [("", ""),
+                  ("+vmem_128m", "--xla_tpu_scoped_vmem_limit_kib=131072"),
+                  ("+lhs", "--xla_tpu_enable_latency_hiding_scheduler=true")])
+        for suffix, flags in cross:
+            grid.append((f"stem_tuned{suffix}", flags, dict(extra)))
+    return grid
+
+
+def resolve_impl_map(arg: str, cpu: bool = False) -> str:
+    """--impl_map value -> the spec _run_config gets: '' (none), an
+    inline spec passed through, or an artifact path made absolute (the
+    child resolves from its own cwd).
+
+    An EXPLICIT --impl_map is obeyed as given.  The default
+    build/impl_map.json is auto-picked only when it is trustworthy for
+    this run: marked complete, and tuned on a matching platform — the
+    documented CPU smoke writes that path too, and a TPU probe silently
+    crossing its flag grid with a CPU-chosen map would publish wrong
+    winners."""
+    if not arg:
+        default = os.path.join(_REPO, "build", "impl_map.json")
+        if not os.path.exists(default):
+            return ""
+        try:
+            with open(default) as fh:
+                art = json.load(fh)
+        except (OSError, ValueError):
+            return ""
+        if not art.get("complete"):
+            return ""
+        tuned_on_cpu = str(art.get("device", "")).lower() == "cpu"
+        return default if tuned_on_cpu == cpu else ""
+    if "=" in arg:
+        return arg
+    return arg if os.path.isabs(arg) else os.path.join(_REPO, arg)
+
 
 def main() -> None:
     ap = argparse.ArgumentParser()
@@ -55,6 +144,10 @@ def main() -> None:
     ap.add_argument("--frames", type=int, default=16)
     ap.add_argument("--size", type=int, default=224)
     ap.add_argument("--timeout", type=float, default=900.0)
+    ap.add_argument("--impl_map", default="",
+                    help="per-stage impl map to cross with the flag "
+                         "grid: inline spec or artifact path; '' = "
+                         "build/impl_map.json when it exists")
     args = ap.parse_args()
 
     # TERMing this probe must reach the live measurement grand-child
@@ -77,24 +170,37 @@ def main() -> None:
             sys.exit(1)
         peak, pin = bench._peak_flops(str(probe.get("kind", ""))), None
 
-    base_flags = os.environ.get("XLA_FLAGS", "")
+    impl_map = resolve_impl_map(args.impl_map, cpu)
+    grid = build_grid(cpu, impl_map)
+
+    base_xla = os.environ.get("XLA_FLAGS", "")
+    base_libtpu = os.environ.get("LIBTPU_INIT_ARGS", "")
     rows = []
     truncated = False
     try:
-        for name, flags in CANDIDATES:
-            os.environ["XLA_FLAGS"] = (base_flags + " " + flags).strip()
+        for name, flags, extra in grid:
+            xla_part, libtpu_part = split_flags(flags)
+            os.environ["XLA_FLAGS"] = (base_xla + " " + xla_part).strip()
+            os.environ["LIBTPU_INIT_ARGS"] = (
+                base_libtpu + " " + libtpu_part).strip()
             try:
                 r = bench._run_config(
                     timeout_s=args.timeout, platform_pin=pin,
                     dtype=args.dtype, batch=args.batch,
                     frames=args.frames, size=args.size, words=20, k=5,
                     remat=False, inner=4 if not cpu else 1, s2d=False,
-                    conv_impl="native", peak=peak, flops_hint=None)
+                    conv_impl="native", peak=peak, flops_hint=None,
+                    **extra)
                 row = {"name": name, "flags": flags,
+                       "impl_map": extra.get("conv_impl_map", ""),
                        "clips_per_sec_per_chip": r["clips_per_sec_per_chip"],
                        "step_ms": r["step_ms"], "mfu": r.get("mfu")}
             except Exception as exc:
+                # _run_config now carries the child's stderr tail for
+                # record-less deaths; keep the whole text — the report
+                # table shows a truncation, the failure section the rest
                 row = {"name": name, "flags": flags,
+                       "impl_map": extra.get("conv_impl_map", ""),
                        "error": f"{type(exc).__name__}: {exc}"}
             print(json.dumps(row), flush=True)
             rows.append(row)
@@ -105,7 +211,8 @@ def main() -> None:
                 # batch-256 failure mode): without this re-probe every later
                 # candidate would burn its full timeout and be recorded as a
                 # flag failure it never earned (bench.run_bench does the same)
-                os.environ["XLA_FLAGS"] = base_flags
+                os.environ["XLA_FLAGS"] = base_xla
+                os.environ["LIBTPU_INIT_ARGS"] = base_libtpu
                 if not bench._probe_backend():
                     truncated = True
                     _write_md(rows, args, truncated)
@@ -115,7 +222,8 @@ def main() -> None:
     finally:
         # an exception escaping the loop (e.g. _write_md IOError) must
         # not leave a candidate's flags polluting the parent environment
-        os.environ["XLA_FLAGS"] = base_flags
+        os.environ["XLA_FLAGS"] = base_xla
+        os.environ["LIBTPU_INIT_ARGS"] = base_libtpu
 
 
 def _write_md(rows, args, truncated=False) -> None:
@@ -127,21 +235,33 @@ def _write_md(rows, args, truncated=False) -> None:
         f"- config: {args.dtype} batch={args.batch} "
         f"{args.frames}f@{args.size}^2, full train step, differenced "
         "timing (4 inner steps/dispatch)",
-        "", "| name | flags | step_ms | clips/s/chip | MFU |",
-        "|---|---|---|---|---|",
+        "- --xla_tpu_* candidates ride LIBTPU_INIT_ARGS (the client-side "
+        "XLA_FLAGS parser aborts on flags it doesn't know — the round-5 "
+        "rc=1 rows); stem_tuned rows apply the per-stage impl map.",
+        "", "| name | flags | map | step_ms | clips/s/chip | MFU |",
+        "|---|---|---|---|---|---|",
     ]
     if truncated:
-        lines.insert(3, "- **PROBE TRUNCATED**: the tunnel wedged "
+        lines.insert(4, "- **PROBE TRUNCATED**: the tunnel wedged "
                      "mid-probe; rows below are what was measured, "
                      "remaining candidates were NOT tested.")
+    failures = []
     for r in rows:
+        mapped = "tuned" if r.get("impl_map") else "-"
         if "error" in r:
+            failures.append(r)
             lines.append(f"| {r['name']} | `{r['flags'] or '(none)'}` | "
-                         f"error: {r['error'][:80]} | | |")
+                         f"{mapped} | error (see below) | | |")
         else:
             lines.append(f"| {r['name']} | `{r['flags'] or '(none)'}` | "
-                         f"{r['step_ms']} | {r['clips_per_sec_per_chip']} | "
+                         f"{mapped} | {r['step_ms']} | "
+                         f"{r['clips_per_sec_per_chip']} | "
                          f"{r.get('mfu', '-')} |")
+    if failures:
+        lines += ["", "## Failures (child stderr captured per row)"]
+        for r in failures:
+            lines += ["", f"### {r['name']}", "```",
+                      r["error"][:2000], "```"]
     with open(os.path.join(_REPO, "XLA_FLAGS_PROBE.md"), "w") as fh:
         fh.write("\n".join(lines) + "\n")
 

@@ -1,6 +1,11 @@
 """Config presets + CLI overrides (replaces args.py/args_small.py)."""
 
-from milnce_tpu.config import parse_cli, small_preset, tiny_preset
+import json
+
+import pytest
+
+from milnce_tpu.config import (CONV_STAGES, parse_cli, parse_conv_impl_map,
+                               small_preset, tiny_preset)
 
 
 def test_full_defaults_match_reference_args():
@@ -73,3 +78,63 @@ def test_tiny_preset_is_hermetic():
     cfg = tiny_preset()
     assert cfg.data.synthetic
     assert cfg.train.batch_size <= 8
+
+
+class TestConvImplMap:
+    """ModelConfig.conv_impl_map parsing: inline specs, autotune
+    artifacts, and the typo-fails-at-config-time contract."""
+
+    def test_empty_spec_is_empty_map(self):
+        assert parse_conv_impl_map("") == {}
+
+    def test_inline_spec(self):
+        got = parse_conv_impl_map("conv1=im2col,mixed_3b=fold2d")
+        assert got == {"conv1": "im2col", "mixed_3b": "fold2d"}
+
+    def test_artifact_path(self, tmp_path):
+        # the shape scripts/stage_probe.py --autotune writes
+        art = {"generator": "scripts/stage_probe.py --autotune",
+               "device": "TPU v5 lite",
+               "impl_map": {"conv1": "im2col"},
+               "stage_ms": {"conv1": {"native": {"fwdbwd": 266.0},
+                                      "im2col": {"fwdbwd": 9.0}}}}
+        path = tmp_path / "impl_map.json"
+        path.write_text(json.dumps(art))
+        assert parse_conv_impl_map(str(path)) == {"conv1": "im2col"}
+
+    def test_raw_json_map_also_accepted(self, tmp_path):
+        path = tmp_path / "map.json"
+        path.write_text(json.dumps({"conv_2c": "fold2d"}))
+        assert parse_conv_impl_map(str(path)) == {"conv_2c": "fold2d"}
+
+    def test_unknown_stage_rejected(self):
+        with pytest.raises(ValueError, match="unknown stage"):
+            parse_conv_impl_map("conv9000=native")
+
+    def test_unknown_impl_rejected(self):
+        with pytest.raises(ValueError, match="unknown impl"):
+            parse_conv_impl_map("conv1=winograd")
+
+    def test_cli_override_reaches_model_config(self):
+        cfg = parse_cli(["--model.conv_impl_map", "conv1=im2col"])
+        assert cfg.model.conv_impl_map == "conv1=im2col"
+
+    def test_stage_names_cover_the_probe_walk(self):
+        # the map grain must match what scripts/stage_probe.py measures
+        assert CONV_STAGES[:3] == ("conv1", "conv_2b", "conv_2c")
+        assert len([s for s in CONV_STAGES if s.startswith("mixed_")]) == 9
+
+    def test_artifact_round_trip_through_build_model(self, tmp_path):
+        """config -> model -> autotune artifact -> reload: the emitted
+        artifact drives build_model and the per-stage resolution."""
+        from milnce_tpu.models.build import build_model
+
+        art = {"generator": "scripts/stage_probe.py --autotune",
+               "impl_map": {"conv1": "im2col", "mixed_5c": "fold2d"}}
+        path = tmp_path / "impl_map.json"
+        path.write_text(json.dumps(art))
+        cfg = small_preset().model
+        cfg.conv_impl_map = str(path)
+        model = build_model(cfg)
+        assert model.conv_impl_map == (("conv1", "im2col"),
+                                       ("mixed_5c", "fold2d"))

@@ -223,3 +223,110 @@ class TestConv3DFold2D:
                                    rtol=1e-4, atol=1e-4)
         np.testing.assert_allclose(np.asarray(t_out), np.asarray(t_ref),
                                    rtol=1e-4, atol=1e-4)
+
+
+class TestConv3DIm2col:
+    """im2col lowers every trunk conv shape as patch extraction + one
+    dot_general with an IDENTICAL parameter layout (models/conv3d.py);
+    its custom VJP keeps dW and dX in matmul form — so BOTH the forward
+    and the gradients must match the native 3D lowering."""
+
+    # the two stem shapes the impl was built for, plus every other
+    # distinct trunk conv shape
+    STEM_SHAPES = [
+        ((3, 7, 7), (2, 2, 2), (1, 3, 3)),       # conv1 stem (full 3D)
+        ((2, 4, 4), (1, 1, 1), (1, 2, 2)),       # s2d stem (even kernel)
+    ]
+    SHAPES = STEM_SHAPES + [
+        ((1, 1, 1), (1, 1, 1), (0, 0, 0)),       # pointwise branches
+        ((1, 3, 3), (1, 1, 1), (0, 1, 1)),       # separable spatial
+        ((3, 1, 1), (1, 1, 1), (1, 0, 0)),       # separable temporal
+        ((1, 7, 7), (1, 2, 2), (0, 3, 3)),       # strided spatial
+    ]
+
+    @pytest.mark.parametrize("kernel,strides,padding", SHAPES)
+    def test_forward_matches_native(self, kernel, strides, padding):
+        from milnce_tpu.models.conv3d import Conv3D
+
+        x = jnp.asarray(np.random.RandomState(0)
+                        .randn(2, 5, 12, 12, 6).astype(np.float32))
+        kw = dict(features=8, kernel_size=kernel, strides=strides,
+                  padding=padding)
+        native = Conv3D(impl="native", **kw)
+        params = native.init(jax.random.PRNGKey(1), x)
+        ref = native.apply(params, x)
+        out = Conv3D(impl="im2col", **kw).apply(params, x)
+        assert out.shape == ref.shape
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("kernel,strides,padding", SHAPES)
+    def test_gradients_match_native(self, kernel, strides, padding):
+        """Parameter AND input gradients of the custom VJP vs native
+        autodiff at EVERY trunk conv shape — the backward is where the
+        measured MFU sink lives (PERF.md), and the autotuner may pick
+        im2col for any stage, so no shape's VJP goes unguarded."""
+        from milnce_tpu.models.conv3d import Conv3D
+
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(2, 5, 12, 12, 6).astype(np.float32))
+        kw = dict(features=8, kernel_size=kernel, strides=strides,
+                  padding=padding)
+        params = Conv3D(impl="native", **kw).init(jax.random.PRNGKey(1), x)
+        cot = jnp.asarray(rng.randn(
+            *Conv3D(impl="native", **kw).apply(params, x).shape)
+            .astype(np.float32))
+
+        def loss(p, xx, impl):
+            # a random cotangent (via the elementwise product) exercises
+            # every output position's contribution to both grads
+            return jnp.sum(Conv3D(impl=impl, **kw).apply(p, xx) * cot)
+
+        gp_ref, gx_ref = jax.grad(loss, argnums=(0, 1))(params, x, "native")
+        gp, gx = jax.grad(loss, argnums=(0, 1))(params, x, "im2col")
+        np.testing.assert_allclose(
+            np.asarray(gp["params"]["kernel"]),
+            np.asarray(gp_ref["params"]["kernel"]), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_unknown_impl_raises(self):
+        from milnce_tpu.models.conv3d import Conv3D
+
+        x = jnp.zeros((1, 3, 8, 8, 2), jnp.float32)
+        conv = Conv3D(features=4, kernel_size=(1, 1, 1), impl="wat")
+        with pytest.raises(ValueError, match="unknown conv impl"):
+            conv.init(jax.random.PRNGKey(0), x)
+
+
+class TestConvImplMap:
+    """Per-stage impl map threading: S3D resolves (stage, impl) pairs at
+    probe granularity, param trees stay identical, unnamed stages fall
+    back to the uniform conv_impl."""
+
+    def test_map_overrides_resolve_per_stage(self):
+        m = tiny_model(conv_impl="fold2d",
+                       conv_impl_map=(("conv1", "im2col"),
+                                      ("mixed_4d", "native")))
+        video = jnp.zeros((1, 4, 32, 32, 3), jnp.float32)
+        text = jnp.zeros((1, 6), jnp.int32)
+        variables = m.init(jax.random.PRNGKey(0), video, text)
+        bound = m.bind(variables)
+        assert bound.conv1.conv_impl == "im2col"
+        assert bound.mixed_4d.conv_impl == "native"
+        # unnamed stages keep the uniform default
+        assert bound.conv_2c.conv_impl == "fold2d"
+        assert bound.mixed_3b.conv_impl == "fold2d"
+
+    def test_mapped_model_matches_native_forward(self):
+        video = jnp.asarray(np.random.RandomState(0)
+                            .rand(1, 4, 32, 32, 3).astype(np.float32))
+        text = jnp.zeros((1, 6), jnp.int32)
+        native = tiny_model()
+        variables = native.init(jax.random.PRNGKey(0), video, text)
+        v_ref, _ = native.apply(variables, video, text)
+        mapped = tiny_model(conv_impl_map=(("conv1", "im2col"),
+                                           ("mixed_3b", "fold2d")))
+        v_out, _ = mapped.apply(variables, video, text)
+        np.testing.assert_allclose(np.asarray(v_out), np.asarray(v_ref),
+                                   rtol=1e-4, atol=1e-4)

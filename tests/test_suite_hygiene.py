@@ -1,0 +1,128 @@
+"""Suite/tooling hygiene gates, fast enough for tier-1.
+
+Two classes of silent rot this pins down:
+
+- **Marker audit**: tests that spawn the measurement stack (bench
+  children, probe subprocesses) are multi-minute; an unmarked one slips
+  into the `-m 'not slow'` tier and eats the 870 s timeout for every
+  later test.  The audit walks the test sources so a NEW probe/autotune
+  test cannot land unmarked.
+- **Report-header lint**: every auto-written report artifact must open
+  by naming its generator — a table whose provenance is guessable only
+  from git archaeology gets trusted (or distrusted) wrongly, and the
+  round-5 advisor already caught two byte-identical probe artifacts
+  drifting apart.
+"""
+
+import ast
+import json
+import os
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TESTS = os.path.join(_REPO, "tests")
+
+# source fragments that mean "this test runs the measurement stack in a
+# child process" — multi-minute by construction
+_EXPENSIVE_FRAGMENTS = ("bench.py", "stage_probe.py", "xla_flag_probe.py",
+                        "real_train_eval.py", "._run_config(")
+
+
+def _is_slow_marked(node, class_slow: bool) -> bool:
+    for deco in getattr(node, "decorator_list", []):
+        text = ast.unparse(deco)
+        if "slow" in text and "mark" in text:
+            return True
+    return class_slow
+
+
+def _iter_tests(tree):
+    """(node, inherits_class_slow_mark) for every test function."""
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            class_slow = _is_slow_marked(node, False)
+            for sub in node.body:
+                if (isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and sub.name.startswith("test")):
+                    yield sub, class_slow
+        elif (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name.startswith("test")):
+            yield node, False
+
+
+def test_measurement_stack_tests_are_slow_marked():
+    offenders = []
+    for fname in sorted(os.listdir(_TESTS)):
+        if not fname.endswith(".py") or fname == os.path.basename(__file__):
+            continue
+        src = open(os.path.join(_TESTS, fname)).read()
+        tree = ast.parse(src)
+        for node, class_slow in _iter_tests(tree):
+            seg = ast.get_source_segment(src, node) or ""
+            # only child-process launches count: monkeypatched fakes and
+            # unit tests of the pure logic are cheap and belong in tier-1
+            spawns = ("sys.executable" in seg
+                      and any(f in seg for f in _EXPENSIVE_FRAGMENTS))
+            calls_real_child = ("._run_config(" in seg
+                                and "monkeypatch" not in seg)
+            if ((spawns or calls_real_child)
+                    and not _is_slow_marked(node, class_slow)):
+                offenders.append(f"{fname}::{node.name}")
+    assert not offenders, (
+        "tests spawning the measurement stack must carry "
+        f"@pytest.mark.slow (tier-1 budget): {offenders}")
+
+
+# artifact -> generator whose name its first line must carry.  Only
+# artifacts present on disk are checked (probe outputs are re-written on
+# the chip; a fresh clone may lack some).
+_REPORT_GENERATORS = {
+    "BENCH_NOTES.md": "bench.py",
+    "STAGE_PROBE.md": "scripts/stage_probe.py",
+    "STAGE_PROBE_native_fwdbwd.md": "scripts/stage_probe.py",
+    "STAGE_AUTOTUNE.md": "scripts/stage_probe.py",
+    "XLA_FLAGS_PROBE.md": "scripts/xla_flag_probe.py",
+    "DATA_BENCH.md": "scripts/data_bench.py",
+}
+
+
+def test_auto_written_reports_name_their_generator():
+    bad = []
+    for fname, generator in _REPORT_GENERATORS.items():
+        path = os.path.join(_REPO, fname)
+        if not os.path.exists(path):
+            continue
+        with open(path) as fh:
+            first = fh.readline()
+        if generator not in first or "auto-written" not in first:
+            bad.append(f"{fname}: {first.strip()!r}")
+    assert not bad, ("auto-written reports must open with "
+                     f"'(auto-written by <generator>)': {bad}")
+
+
+def test_report_writers_emit_generator_headers():
+    """Source-side half of the lint: every md-writing helper in the
+    measurement scripts opens its artifact with the auto-written header,
+    so a NEW report can't ship anonymous."""
+    writers = {
+        os.path.join(_REPO, "bench.py"): "auto-written by bench.py",
+        os.path.join(_REPO, "scripts", "stage_probe.py"):
+            "auto-written by scripts/stage_probe.py",
+        os.path.join(_REPO, "scripts", "xla_flag_probe.py"):
+            "auto-written by scripts/xla_flag_probe.py",
+        os.path.join(_REPO, "scripts", "data_bench.py"):
+            "auto-written by scripts/data_bench.py",
+    }
+    for path, header in writers.items():
+        assert header in open(path).read(), (
+            f"{os.path.basename(path)} writes a report without naming "
+            f"itself ('{header}')")
+
+
+def test_autotune_artifact_carries_generator_key():
+    """The JSON impl-map artifact can't carry a markdown header; its
+    'generator' key is the same contract."""
+    path = os.path.join(_REPO, "build", "impl_map.json")
+    if not os.path.exists(path):
+        return
+    art = json.load(open(path))
+    assert art["generator"].startswith("scripts/stage_probe.py")
