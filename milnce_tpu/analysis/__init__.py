@@ -1,0 +1,22 @@
+"""graftlint: JAX-aware static analysis + trace invariants for the hot path.
+
+Two complementary passes guard the throughput story (PR 1 spent ~1.5k LoC
+winning back stem MFU; nothing else stops a later change from silently
+reintroducing per-step host syncs, f64 drift, recompilation storms or
+undonated buffers):
+
+- :mod:`milnce_tpu.analysis.astlint` — pure-AST lint (no jax import) with
+  JAX-specific rules (:mod:`milnce_tpu.analysis.rules`) and an inline
+  ``# graftlint: disable=RULE(reason)`` suppression syntax, so audited
+  exceptions stay documented instead of silenced;
+- :mod:`milnce_tpu.analysis.trace_invariants` — traces the registered
+  entry points (train step variants, soft-DTW ops, eval retrieval) under
+  a CPU mesh and asserts jaxpr-level invariants: no float64 anywhere,
+  the expected collective count per step, identical param treedefs
+  across conv impls, and a double-call recompile detector.
+
+CLI: ``scripts/graft_lint.py`` (writes LINT.md; ``--check`` exits
+nonzero on findings).  Rule catalogue: ANALYSIS.md.
+"""
+
+from milnce_tpu.analysis.rules import RULES, Rule  # noqa: F401

@@ -1,0 +1,595 @@
+"""graftlint Pass 1: pure-AST lint with JAX-specific rules.
+
+Deliberately imports no jax — ``scripts/graft_lint.py --check --no-trace``
+must cost milliseconds from a cold interpreter so it can gate every test
+run and pre-commit hook.  The analysis is intra-module and heuristic
+(documented per rule in ANALYSIS.md); the design bias is *low false
+negatives on the potholes that cost TPU throughput*, with the inline
+suppression syntax absorbing the audited exceptions::
+
+    lr = float(x)  # graftlint: disable=GL001(display-cadence, audited)
+
+Scope heuristics this pass relies on:
+
+- **traced scope** (GL002/GL006): a function is considered traced when it
+  is decorated with (or passed by name to) a JAX tracing transform
+  (``jit``/``shard_map``/``scan``/``grad``/...), plus every function
+  nested inside one.  ``static_argnames``/``static_argnums`` of the
+  jit/scan site are honored when tainting parameters.
+- **hot region** (GL001): the body of any ``for`` loop iterating
+  ``device_prefetch(...)`` — the canonical training hot loop — plus the
+  transitive closure of same-module functions called (by bare name) from
+  inside it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+from milnce_tpu.analysis.rules import RULES, Rule, resolve_rule
+
+# Terminal callee names that put their function arguments under trace.
+_TRACERS = {
+    "jit", "pjit", "shard_map", "scan", "vmap", "pmap", "grad",
+    "value_and_grad", "vjp", "jvp", "linearize", "checkpoint", "remat",
+    "eval_shape", "make_jaxpr", "pallas_call", "fori_loop", "while_loop",
+    "cond", "switch", "custom_vjp", "custom_jvp", "associative_scan",
+}
+# Roots an Attribute chain must start from for a terminal match to count
+# (avoids flagging `csvreader.scan(...)`); bare names always count.
+_TRACE_ROOTS = {"jax", "lax", "jnp", "pl", "pallas", "nn", "flax"}
+
+# Attribute reads that turn a traced array into static Python data.
+_TAINT_BREAKERS = {"shape", "ndim", "dtype", "size", "sharding", "aval",
+                   "itemsize", "weak_type"}
+
+# Host-sync call families (GL001); device_get and block_until_ready are
+# matched inline in _check_hot_body (the latter in method form too).
+_SYNC_BARE = {"float", "int", "bool", "complex"}
+_SYNC_NP = {"asarray", "array"}
+
+_ARRAY_ROOTS = {"np", "numpy", "jnp"}
+_FLOAT_DEFAULT_CTORS = {"zeros", "ones", "empty", "linspace", "eye"}
+_VALUE_CTORS = {"array", "asarray", "full"}
+
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=(?P<body>.+)$")
+_ITEM_RE = re.compile(r"\s*(?P<rule>[A-Za-z0-9_-]+)\s*(?:\((?P<reason>.*)\))?\s*$")
+
+
+@dataclass
+class Suppression:
+    line: int
+    rule_id: str            # normalized to the GLnnn id
+    reason: str
+    standalone: bool        # comment-only line: applies to the line below
+
+
+@dataclass
+class Finding:
+    path: str
+    line: int
+    rule: Rule
+    message: str
+    suppressed: bool = False
+    suppress_reason: str = ""
+
+    def format(self) -> str:
+        tag = f" [suppressed: {self.suppress_reason}]" if self.suppressed else ""
+        return (f"{self.path}:{self.line}: {self.rule.id} "
+                f"({self.rule.name}) {self.message}{tag}")
+
+
+def _comment_tokens(src: str):
+    """(lineno, comment_text, standalone) for every real COMMENT token —
+    tokenizing (rather than regexing lines) keeps docstrings and strings
+    that merely *mention* the suppression syntax from parsing as one."""
+    import io
+    import tokenize
+
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(src).readline))
+    except (tokenize.TokenError, SyntaxError):  # pragma: no cover
+        return
+    for tok in tokens:
+        if tok.type == tokenize.COMMENT:
+            line_prefix = tok.line[:tok.start[1]]
+            yield tok.start[0], tok.string, line_prefix.strip() == ""
+
+
+def parse_suppressions(src: str, path: str) -> tuple[list[Suppression],
+                                                     list[Finding]]:
+    """Collect ``# graftlint: disable=RULE(reason)[,...]`` comments.
+
+    Malformed items (unknown rule, missing reason) become GL000 findings —
+    a suppression that doesn't document itself suppresses nothing."""
+    sups: list[Suppression] = []
+    bad: list[Finding] = []
+    for lineno, text, standalone in _comment_tokens(src):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        # split on commas OUTSIDE parens so reasons may contain commas
+        body, items, depth, cur = m.group("body"), [], 0, ""
+        for ch in body:
+            depth += ch == "("
+            depth -= ch == ")"
+            if ch == "," and depth == 0:
+                items.append(cur)
+                cur = ""
+            else:
+                cur += ch
+        items.append(cur)
+        for item in items:
+            im = _ITEM_RE.match(item)
+            rule = resolve_rule(im.group("rule")) if im else None
+            reason = (im.group("reason") or "").strip() if im else ""
+            if rule is None:
+                bad.append(Finding(path, lineno, RULES["GL000"],
+                                   f"unknown rule in suppression: {item.strip()!r}"))
+            elif not reason:
+                bad.append(Finding(path, lineno, RULES["GL000"],
+                                   f"suppression of {rule.id} carries no reason "
+                                   "(write disable=RULE(reason))"))
+            else:
+                sups.append(Suppression(lineno, rule.id, reason, standalone))
+    return sups, bad
+
+
+def _terminal_and_root(node: ast.expr) -> tuple[str | None, str | None]:
+    """('jit', 'jax') for jax.jit / jax.experimental.pjit.pjit; bare Name
+    returns (name, name)."""
+    if isinstance(node, ast.Name):
+        return node.id, node.id
+    if isinstance(node, ast.Attribute):
+        terminal = node.attr
+        cur = node.value
+        while isinstance(cur, ast.Attribute):
+            cur = cur.value
+        return terminal, (cur.id if isinstance(cur, ast.Name) else None)
+    return None, None
+
+
+def _is_tracer_callee(func: ast.expr) -> bool:
+    terminal, root = _terminal_and_root(func)
+    if terminal is None:
+        return False
+    if isinstance(func, ast.Name):
+        return terminal in _TRACERS
+    return terminal in _TRACERS and root in _TRACE_ROOTS
+
+
+def _static_names_from_call(call: ast.Call, fn: ast.FunctionDef | None) -> set:
+    """Resolve static_argnames/static_argnums kwargs to parameter names."""
+    out: set[str] = set()
+    params = [a.arg for a in fn.args.args] if fn is not None else []
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    out.add(n.value)
+        elif kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if (isinstance(n, ast.Constant) and isinstance(n.value, int)
+                        and 0 <= n.value < len(params)):
+                    out.add(params[n.value])
+    return out
+
+
+class _TaintCheck(ast.NodeVisitor):
+    """Does this expression's value depend on a tainted (traced) name?
+    Descent stops at shape/dtype-like attribute reads and len()."""
+
+    def __init__(self, tainted: set):
+        self.tainted = tainted
+        self.hit = False
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if node.id in self.tainted:
+            self.hit = True
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if node.attr in _TAINT_BREAKERS:
+            return                      # x.shape is static under jit
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        terminal, _ = _terminal_and_root(node.func)
+        if terminal in ("len", "isinstance", "type", "hasattr"):
+            return                      # static under jit (shape-derived)
+        self.generic_visit(node)
+
+
+def _expr_tainted(node: ast.expr, tainted: set) -> bool:
+    chk = _TaintCheck(tainted)
+    chk.visit(node)
+    return chk.hit
+
+
+def _assigned_names(target: ast.expr):
+    for n in ast.walk(target):
+        if isinstance(n, ast.Name):
+            yield n.id
+
+
+class _ModuleLint:
+    def __init__(self, src: str, path: str):
+        self.src = src
+        self.path = path
+        self.tree = ast.parse(src)
+        self.findings: list[Finding] = []
+        self.imports_jax = bool(re.search(
+            r"^\s*(import jax|from jax|import jax\.numpy)", src, re.M))
+        # name -> ALL defs sharing that bare name (incl. nested): two
+        # factories each defining `def local(...)` is the NORM in this
+        # codebase (train/step.py), and keeping only the first would
+        # silently exempt every later body from the traced-scope checks
+        self.defs: dict[str, list] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs.setdefault(node.name, []).append(node)
+        self.traced_roots: dict[str, set] = {}   # fn name -> static params
+        self._discover_traced_roots()
+
+    # ---- shared helpers -------------------------------------------------
+
+    def _emit(self, rule_id: str, node: ast.AST, message: str) -> None:
+        self.findings.append(Finding(self.path, node.lineno,
+                                     RULES[rule_id], message))
+
+    # ---- traced-scope discovery ----------------------------------------
+
+    def _discover_traced_roots(self) -> None:
+        # decorators
+        for name, fns in self.defs.items():
+            for fn in fns:
+                for deco in fn.decorator_list:
+                    hit = any(_is_tracer_callee(n) for n in ast.walk(deco)
+                              if isinstance(n, (ast.Name, ast.Attribute)))
+                    if hit:
+                        statics = (_static_names_from_call(deco, fn)
+                                   if isinstance(deco, ast.Call) else set())
+                        self.traced_roots.setdefault(name,
+                                                     set()).update(statics)
+        # call sites: jax.jit(f, ...), lax.scan(body, ...), shard_map(f, ...)
+        # — a bare name marks EVERY def sharing it (conservative: name
+        # resolution without scope analysis)
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Call)
+                    and _is_tracer_callee(node.func)):
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Name) and arg.id in self.defs:
+                    self.traced_roots.setdefault(arg.id, set())
+                    for fn in self.defs[arg.id]:
+                        self.traced_roots[arg.id].update(
+                            _static_names_from_call(node, fn))
+
+    # ---- GL002 / GL006: traced-scope body checks ------------------------
+
+    def check_traced_scopes(self) -> None:
+        for name, statics in self.traced_roots.items():
+            for fn in self.defs[name]:
+                params = {a.arg for a in fn.args.args
+                          + fn.args.posonlyargs + fn.args.kwonlyargs}
+                self._check_traced_fn(fn, params - statics)
+
+    def _check_traced_fn(self, fn, inherited: set) -> None:
+        tainted = set(inherited)
+        for stmt in fn.body:
+            self._walk_traced_stmt(stmt, tainted)
+
+    def _walk_traced_stmt(self, stmt: ast.stmt, tainted: set) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def runs under the same trace; closure taint flows in
+            params = {a.arg for a in stmt.args.args
+                      + stmt.args.posonlyargs + stmt.args.kwonlyargs}
+            self._check_traced_fn(stmt, tainted | params)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            value = stmt.value
+            if value is not None and _expr_tainted(value, tainted):
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                for t in targets:
+                    tainted.update(_assigned_names(t))
+        if isinstance(stmt, ast.If) and _expr_tainted(stmt.test, tainted):
+            self._emit("GL002", stmt,
+                       "Python `if` on a traced value — use lax.cond / "
+                       "jnp.where, or hoist to build time")
+        elif isinstance(stmt, ast.While) and _expr_tainted(stmt.test, tainted):
+            self._emit("GL002", stmt,
+                       "Python `while` on a traced value — use "
+                       "lax.while_loop")
+        elif isinstance(stmt, ast.For) and _expr_tainted(stmt.iter, tainted):
+            self._emit("GL002", stmt,
+                       "Python `for` over a traced value — use lax.scan / "
+                       "lax.fori_loop")
+        for node in ast.iter_child_nodes(stmt):
+            if isinstance(node, ast.stmt):
+                self._walk_traced_stmt(node, tainted)
+            elif isinstance(node, ast.expr):
+                self._check_traced_exprs(node, tainted)
+        # statements nested in expressions (rare) are not walked further
+
+    def _check_traced_exprs(self, node: ast.expr, tainted: set) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.IfExp) and _expr_tainted(sub.test, tainted):
+                self._emit("GL002", sub,
+                           "conditional expression on a traced value — use "
+                           "jnp.where / lax.select")
+            elif (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Name)
+                    and sub.func.id == "print"):
+                self._emit("GL006", sub,
+                           "print() under trace fires once with tracers — "
+                           "use jax.debug.print")
+
+    # ---- GL001: hot-region host syncs -----------------------------------
+
+    def check_hot_regions(self) -> None:
+        hot_bodies: list[list[ast.stmt]] = []
+        for node in ast.walk(self.tree):
+            if (isinstance(node, ast.For)
+                    and "device_prefetch" in ast.unparse(node.iter)):
+                hot_bodies.append(node.body)
+        if not hot_bodies:
+            return
+        # transitive closure over same-module functions called by bare name
+        seen: set[str] = set()
+        queue = list(hot_bodies)
+        while queue:
+            body = queue.pop()
+            for call in self._calls_in(body):
+                if isinstance(call.func, ast.Name):
+                    callee = call.func.id
+                    if callee in self.defs and callee not in seen:
+                        seen.add(callee)
+                        queue.extend(fn.body for fn in self.defs[callee])
+            self._check_hot_body(body)
+
+    def _calls_in(self, body: list[ast.stmt]):
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    yield node
+
+    def _check_hot_body(self, body: list[ast.stmt]) -> None:
+        for call in self._calls_in(body):
+            terminal, root = _terminal_and_root(call.func)
+            if (isinstance(call.func, ast.Name)
+                    and terminal in _SYNC_BARE
+                    and call.args
+                    and not isinstance(call.args[0], ast.Constant)):
+                self._emit("GL001", call,
+                           f"{terminal}() on a (possibly device) value in "
+                           "the hot loop blocks the host")
+            elif terminal == "item" and not call.args:
+                self._emit("GL001", call,
+                           ".item() in the hot loop blocks the host")
+            elif terminal == "block_until_ready":
+                # function form (jax.block_until_ready(x)) AND the
+                # idiomatic method form (x.block_until_ready()) — both
+                # stall the dispatch pipeline per step
+                self._emit("GL001", call,
+                           "block_until_ready() in the hot loop stalls "
+                           "the dispatch pipeline")
+            elif terminal == "device_get" and root == "jax":
+                self._emit("GL001", call,
+                           "jax.device_get() in the hot loop blocks the "
+                           "host")
+            elif (terminal in _SYNC_NP and root in ("np", "numpy")):
+                self._emit("GL001", call,
+                           f"{root}.{terminal}() on a device value in the "
+                           "hot loop forces a synchronous D2H copy")
+
+    # ---- GL003: jit without donation ------------------------------------
+
+    _STEPISH = re.compile(r"(^|_)(train_)?(step|loop)\b|(^|_)step(_|$)")
+    _FACTORY = re.compile(r"make_\w*step")
+
+    def check_donation(self) -> None:
+        # call form: jax.jit(fn, ...)
+        parents = _parent_functions(self.tree)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            terminal, root = _terminal_and_root(node.func)
+            if terminal != "jit" or root not in ("jax", "jit"):
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Name):
+                continue
+            arg_name = node.args[0].id
+            encl = parents.get(id(node), "")
+            stepish = (self._STEPISH.search(arg_name)
+                       or self._FACTORY.search(encl))
+            has_donate = any(kw.arg in ("donate_argnums", "donate_argnames")
+                             for kw in node.keywords)
+            if stepish and not has_donate:
+                self._emit("GL003", node,
+                           f"jax.jit({arg_name}) looks train-step-shaped "
+                           "but donates no buffers — pass donate_argnums "
+                           "for the consumed state")
+        # decorator form: @jax.jit on def *step*
+        for name, fns in self.defs.items():
+            if not self._STEPISH.search(name):
+                continue
+            for fn in fns:
+                self._check_decorated_donation(name, fn)
+
+    def _check_decorated_donation(self, name: str, fn) -> None:
+        for deco in fn.decorator_list:
+            terminal, _root = _terminal_and_root(
+                deco.func if isinstance(deco, ast.Call) else deco)
+            if terminal != "jit":
+                continue
+            has_donate = (isinstance(deco, ast.Call) and any(
+                kw.arg in ("donate_argnums", "donate_argnames")
+                for kw in deco.keywords))
+            if not has_donate:
+                self._emit("GL003", fn,
+                           f"@jit on {name}() donates no buffers — "
+                           "pass donate_argnums for the consumed state")
+
+    # ---- GL004: f64 drift ------------------------------------------------
+
+    @staticmethod
+    def _has_dtype_arg(node: ast.Call) -> bool:
+        """dtype given as keyword OR positionally (np.zeros(shape, f32)):
+        any positional arg that reads like a dtype counts."""
+        if any(kw.arg == "dtype" for kw in node.keywords):
+            return True
+        for arg in node.args:
+            for sub in ast.walk(arg):
+                name = (sub.attr if isinstance(sub, ast.Attribute)
+                        else sub.id if isinstance(sub, ast.Name)
+                        else sub.value if (isinstance(sub, ast.Constant)
+                                           and isinstance(sub.value, str))
+                        else "")
+                if isinstance(name, str) and (
+                        name.startswith(("float", "int", "uint", "bfloat",
+                                         "complex", "bool_"))
+                        or "dtype" in name):
+                    return True
+        return False
+
+    def check_f64(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Attribute) and node.attr == "float64":
+                _, root = _terminal_and_root(node)
+                if root in _ARRAY_ROOTS or root == "jax":
+                    self._emit("GL004", node,
+                               f"explicit float64 dtype ({root}.float64) — "
+                               "f64 operands upcast everything downstream")
+            if not isinstance(node, ast.Call):
+                continue
+            terminal, root = _terminal_and_root(node.func)
+            if root not in _ARRAY_ROOTS:
+                continue
+            if self._has_dtype_arg(node):
+                continue
+            if terminal in _FLOAT_DEFAULT_CTORS:
+                self._emit("GL004", node,
+                           f"{root}.{terminal}() without dtype= defaults to "
+                           "float64 (numpy always, jax under x64)")
+            elif terminal in _VALUE_CTORS and any(
+                    isinstance(a, ast.Constant) and isinstance(a.value, float)
+                    for arg in node.args for a in ast.walk(arg)):
+                self._emit("GL004", node,
+                           f"{root}.{terminal}() of a float literal without "
+                           "dtype= upcasts to float64 under x64")
+
+    # ---- GL005: unsynced wall-clock timing -------------------------------
+
+    @staticmethod
+    def _own_nodes(fn):
+        """Descendants of ``fn`` excluding nested function bodies (those
+        are audited as their own timing scopes)."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def check_timing(self) -> None:
+        if not self.imports_jax:
+            return
+        for name, fns in self.defs.items():
+          for fn in fns:
+            clock_calls = []
+            has_block = False
+            for node in self._own_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                terminal, root = _terminal_and_root(node.func)
+                if root == "time" and terminal in ("time", "perf_counter",
+                                                   "monotonic"):
+                    clock_calls.append(node)
+                if terminal == "block_until_ready":
+                    has_block = True
+            if len(clock_calls) >= 2 and not has_block:
+                clock_calls.sort(key=lambda n: n.lineno)
+                self._emit("GL005", clock_calls[0],
+                           f"{name}() reads the wall clock {len(clock_calls)}x "
+                           "with no block_until_ready — async dispatch makes "
+                           "the delta measure enqueue, not device work")
+
+    # ---- driver ----------------------------------------------------------
+
+    def run(self) -> list[Finding]:
+        self.check_traced_scopes()
+        self.check_hot_regions()
+        self.check_donation()
+        self.check_f64()
+        self.check_timing()
+        return self.findings
+
+
+def _parent_functions(tree: ast.Module) -> dict:
+    """id(node) -> name of the nearest enclosing function."""
+    out: dict[int, str] = {}
+
+    def walk(node, current):
+        for child in ast.iter_child_nodes(node):
+            name = (child.name
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                    else current)
+            out[id(child)] = current
+            walk(child, name)
+
+    walk(tree, "")
+    return out
+
+
+def lint_source(src: str, path: str = "<string>") -> list[Finding]:
+    """All findings for one module, suppressions applied (suppressed
+    findings are RETURNED with .suppressed=True so reports can list the
+    audited exceptions; callers gate on the unsuppressed subset)."""
+    sups, bad = parse_suppressions(src, path)
+    findings = _ModuleLint(src, path).run()
+    by_line: dict[tuple[int, str], Suppression] = {}
+    for s in sups:
+        target = s.line + 1 if s.standalone else s.line
+        by_line[(target, s.rule_id)] = s
+    for f in findings:
+        s = by_line.get((f.line, f.rule.id))
+        if s is not None:
+            f.suppressed = True
+            f.suppress_reason = s.reason
+    findings.extend(bad)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule.id))
+    return findings
+
+
+def lint_paths(paths: list[str]) -> list[Finding]:
+    """Lint every .py under the given files/directories.
+
+    A path that matches no Python files raises instead of being
+    silently dropped — a typo'd scope argument must fail the gate
+    loudly, not let it pass green while checking nothing."""
+    files: list[str] = []
+    for p in paths:
+        found: list[str] = []
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                if "__pycache__" in root:
+                    continue
+                found.extend(os.path.join(root, n) for n in names
+                             if n.endswith(".py"))
+        elif os.path.isfile(p) and p.endswith(".py"):
+            found.append(p)
+        if not found:
+            raise FileNotFoundError(
+                f"lint scope {p!r} matches no Python files — typo'd path? "
+                "(a silently empty scope would pass the gate vacuously)")
+        files.extend(found)
+    out: list[Finding] = []
+    for fname in sorted(files):
+        with open(fname) as fh:
+            out.extend(lint_source(fh.read(), fname))
+    return out

@@ -1,0 +1,79 @@
+"""LINT.md emission for graftlint.
+
+Follows the repo's report-header convention (tests/test_suite_hygiene.py):
+every auto-written artifact opens by naming its generator — the header
+string "(auto-written by scripts/graft_lint.py)" below is what the
+hygiene lint pins.  The report's job is not just pass/fail: the
+suppression table is the living registry of every audited hot-path
+exception, with its reason, so "what syncs are we allowing and why" has
+one answer.
+"""
+
+from __future__ import annotations
+
+from milnce_tpu.analysis.astlint import Finding
+from milnce_tpu.analysis.rules import RULES
+
+HEADER = ("<!-- (auto-written by scripts/graft_lint.py — do not hand-edit; "
+          "regenerate with `python scripts/graft_lint.py`) -->\n")
+
+
+def render_report(findings: list[Finding], trace_results=None,
+                  paths=None) -> str:
+    active = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+    lines = [HEADER, "# graftlint report", ""]
+    if paths:
+        lines.append(f"Scope: `{'`, `'.join(paths)}`")
+        lines.append("")
+
+    lines.append("## Pass 1 — AST lint")
+    lines.append("")
+    lines.append(f"- findings: **{len(active)}**")
+    lines.append(f"- audited suppressions in force: {len(suppressed)}")
+    lines.append("")
+    if active:
+        lines.append("| where | rule | finding |")
+        lines.append("|---|---|---|")
+        for f in active:
+            lines.append(f"| `{f.path}:{f.line}` | {f.rule.id} "
+                         f"({f.rule.name}) | {f.message} |")
+        lines.append("")
+    if suppressed:
+        lines.append("### Audited exceptions (inline suppressions)")
+        lines.append("")
+        lines.append("| where | rule | reason |")
+        lines.append("|---|---|---|")
+        for f in suppressed:
+            lines.append(f"| `{f.path}:{f.line}` | {f.rule.id} "
+                         f"({f.rule.name}) | {f.suppress_reason} |")
+        lines.append("")
+
+    lines.append("## Pass 2 — trace invariants")
+    lines.append("")
+    if trace_results is None:
+        lines.append("(skipped — run without `--no-trace` for jaxpr-level "
+                     "checks)")
+    else:
+        bad = [r for r in trace_results if not r.ok]
+        lines.append(f"- checks: {len(trace_results)}, failing: "
+                     f"**{len(bad)}**")
+        lines.append("")
+        lines.append("| entry | check | status |")
+        lines.append("|---|---|---|")
+        for r in trace_results:
+            status = "ok" if r.ok else f"**FAIL** — {r.detail}"
+            lines.append(f"| {r.entry} | {r.check} | {status} |")
+    lines.append("")
+
+    lines.append("## Rules")
+    lines.append("")
+    lines.append("| id | name | guards against |")
+    lines.append("|---|---|---|")
+    for rule in RULES.values():
+        lines.append(f"| {rule.id} | {rule.name} | {rule.summary} |")
+    lines.append("")
+    lines.append("Full rationale, examples and the suppression syntax: "
+                 "ANALYSIS.md.")
+    lines.append("")
+    return "\n".join(lines)

@@ -1,0 +1,127 @@
+"""graftlint rule catalogue.
+
+Every rule exists because the corresponding pothole has already cost (or
+would silently cost) real TPU throughput in this codebase; ANALYSIS.md
+carries the long-form rationale and a worked example per rule.  Rules are
+addressed by ID (``GL001``) or name (``host-sync-hot-loop``) — both work
+in the suppression syntax::
+
+    x = float(loss)  # graftlint: disable=GL001(display-cadence fetch)
+
+A suppression must carry a non-empty reason; a bare ``disable=GL001`` is
+itself a finding (GL000) so exceptions stay *documented*, never silent.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    summary: str
+    rationale: str
+    example: str
+    fix: str
+
+
+_RULE_LIST = (
+    Rule(
+        id="GL000",
+        name="bad-suppression",
+        summary="malformed graftlint suppression comment",
+        rationale="A suppression without a reason (or naming an unknown "
+                  "rule) silences findings without documenting why; the "
+                  "whole point of the inline syntax is that every audited "
+                  "exception carries its audit.",
+        example="x = float(loss)  # graftlint: disable=GL001",
+        fix="write `# graftlint: disable=GL001(<why this sync is safe>)`",
+    ),
+    Rule(
+        id="GL001",
+        name="host-sync-hot-loop",
+        summary="host-blocking call reachable from the training hot loop",
+        rationale="float()/int()/.item()/np.asarray()/jax.device_get() on "
+                  "a device value blocks the host until the device "
+                  "catches up, defeating the async dispatch pipeline "
+                  "device_prefetch exists to enable — the reference loses "
+                  "throughput to exactly this (loss.item() per batch).",
+        example="loss_val = float(loss)  # inside the per-batch loop",
+        fix="accumulate on device; transfer only at n_display cadence "
+            "(and suppress that audited fetch with a reason)",
+    ),
+    Rule(
+        id="GL002",
+        name="traced-python-flow",
+        summary="Python if/for/while on a traced value inside jitted code",
+        rationale="Branching on a tracer either crashes at trace time "
+                  "(ConcretizationTypeError) or — via `static_argnums` "
+                  "promotion or weak-type coincidence — silently builds a "
+                  "new XLA program per value: a recompilation storm.",
+        example="if x > 0:  # x is a traced array",
+        fix="use lax.cond/lax.select/jnp.where, or hoist the decision to "
+            "build time (shapes and config are static)",
+    ),
+    Rule(
+        id="GL003",
+        name="jit-missing-donate",
+        summary="jax.jit of a train-step-shaped function without "
+                "donate_argnums",
+        rationale="A train step that updates a TrainState without "
+                  "donating it keeps TWO copies of params+opt_state live "
+                  "across the update — at real scale that is the "
+                  "difference between fitting the batch and OOM, and XLA "
+                  "cannot reuse the input buffers in place.",
+        example="step = jax.jit(train_step)",
+        fix="jax.jit(train_step, donate_argnums=(0,)) — donate the state "
+            "argument that the step consumes and returns",
+    ),
+    Rule(
+        id="GL004",
+        name="f64-literal-drift",
+        summary="array construction that lands in float64 under x64 "
+                "(or anywhere)",
+        rationale="np.zeros()/jnp.asarray(0.5) without an explicit dtype "
+                  "default to float64 (numpy always; jax under "
+                  "jax_enable_x64).  An f64 operand silently upcasts "
+                  "every downstream op — 2x HBM traffic and off the MXU "
+                  "fast path — and H2D transfers double in size.",
+        example="pad = jnp.asarray(0.5)  # f64 under x64",
+        fix="pass dtype= explicitly (np.float32, or the model's compute "
+            "dtype)",
+    ),
+    Rule(
+        id="GL005",
+        name="unsynced-walltime",
+        summary="wall-clock timing without a device sync",
+        rationale="JAX dispatch is async: time.time() deltas around a "
+                  "jitted call measure enqueue latency, not device work. "
+                  "Every headline number in BENCH_NOTES.md exists because "
+                  "naive timing once reported 11.5 ms for a 5 us kernel.",
+        example="t0 = time.time(); f(x); dt = time.time() - t0",
+        fix="jax.block_until_ready(result) before reading the clock (or "
+            "materialize the value on host, utils/timing.py protocol)",
+    ),
+    Rule(
+        id="GL006",
+        name="print-under-trace",
+        summary="print() inside jit-traced code",
+        rationale="print in traced code fires once at trace time (showing "
+                  "tracers, not values) and never again — it reads like "
+                  "per-step logging but is neither per-step nor values; "
+                  "with impure callbacks it can also pin a host sync.",
+        example="print('loss', loss)  # inside the jitted step",
+        fix="jax.debug.print for traced values; host-side logging belongs "
+            "outside the step at display cadence",
+    ),
+)
+
+RULES: dict[str, Rule] = {r.id: r for r in _RULE_LIST}
+RULES_BY_NAME: dict[str, Rule] = {r.name: r for r in _RULE_LIST}
+
+
+def resolve_rule(token: str) -> Rule | None:
+    """Accept either a rule ID ('GL001') or name ('host-sync-hot-loop')."""
+    return RULES.get(token) or RULES_BY_NAME.get(token)

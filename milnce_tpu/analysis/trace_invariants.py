@@ -1,0 +1,331 @@
+"""graftlint Pass 2: trace-level invariants over the registered entry points.
+
+Where Pass 1 reads source, this pass reads *jaxprs*: every hot-path entry
+point (train step variants, soft-DTW ops, eval retrieval embedders) is
+traced on a hermetic CPU mesh (the same 8-virtual-device layout the test
+suite uses) and checked for the regressions that erase TPU throughput
+without failing any functional test:
+
+- **no-f64**: no value of dtype float64 anywhere in the jaxpr, and no
+  ``convert_element_type`` targeting it — one f64 operand upcasts every
+  downstream op (2x HBM traffic, off the MXU fast path);
+- **collectives**: the exact multiset of collective primitives per step
+  is pinned for the 8-way data mesh.  A diff means the communication
+  structure changed — sometimes intended (then re-pin the number in
+  ``EXPECTED_COLLECTIVES``, consciously), often a silent extra gather
+  or a lost psum;
+- **treedef**: the three conv formulations (native / fold2d / im2col)
+  must init byte-identical param trees — the per-stage impl map
+  (ModelConfig.conv_impl_map) and checkpoint portability both rely on
+  it;
+- **recompile**: each executable entry point is called twice with fresh
+  same-shaped inputs and must hit the jit cache the second time — a
+  miss is the seed of a recompilation storm (weak-type drift, unstable
+  static argument, non-hashable closure).
+
+Everything here must run under ``JAX_PLATFORMS=cpu`` in tier-1 time:
+the model is a 1-block S3D at 4 frames / 32 px.  jax imports live
+inside functions so ``astlint`` stays importable without jax.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+# Collective primitives whose per-step count we pin.
+COLLECTIVES = ("psum", "all_gather", "psum_scatter", "all_to_all",
+               "ppermute", "pbroadcast")
+
+# Pinned per-entry collective multisets for the 8-way data mesh (absent
+# primitive = expected 0).  Derived by tracing on the tiny entry config;
+# the invariant is that they never change SILENTLY — a deliberate
+# communication-structure change re-pins the number in the same commit.
+#
+# Reading the milnce step: 2 all_gathers (video+text negatives ride ICI
+# once each); the 26 psums are the scalar loss reduction, the leaf-wise
+# grad psum, and the pmean-lowered BatchNorm stat merges.  sdtw_3 trades
+# one psum for a third all_gather (clip start-times feed the alignment).
+EXPECTED_COLLECTIVES = {
+    "train_step_milnce": {"all_gather": 2, "psum": 26},
+    "train_step_sdtw3": {"all_gather": 3, "psum": 25},
+    "grad_cache_step_milnce": {"all_gather": 2, "psum": 26},
+    "video_embed": {},
+    "text_embed": {},
+    "softdtw_scan_grad": {},
+}
+
+
+@dataclass
+class CheckResult:
+    entry: str
+    check: str              # no-f64 | collectives | treedef | recompile
+    ok: bool
+    detail: str = ""
+
+    def format(self) -> str:
+        mark = "ok" if self.ok else "FAIL"
+        tail = f" — {self.detail}" if self.detail else ""
+        return f"[{mark}] {self.entry}/{self.check}{tail}"
+
+
+# --------------------------------------------------------------------------
+# jaxpr utilities
+# --------------------------------------------------------------------------
+
+def iter_eqns(jaxpr):
+    """Every eqn in a (possibly nested) jaxpr, including the inner jaxprs
+    of pjit / shard_map / scan / custom_vjp / pallas_call params."""
+    import jax
+
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for p in eqn.params.values():
+            vals = p if isinstance(p, (list, tuple)) else [p]
+            for v in vals:
+                if isinstance(v, jax.core.ClosedJaxpr):
+                    yield from iter_eqns(v.jaxpr)
+                elif isinstance(v, jax.core.Jaxpr):
+                    yield from iter_eqns(v)
+
+
+def collective_counts(jaxpr) -> dict:
+    out: dict[str, int] = {}
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name in COLLECTIVES:
+            out[eqn.primitive.name] = out.get(eqn.primitive.name, 0) + 1
+    return out
+
+
+def f64_sites(jaxpr) -> list[str]:
+    """Primitive names whose inputs or outputs carry float64."""
+    import numpy as np
+
+    sites = []
+    for eqn in iter_eqns(jaxpr):
+        for v in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(v, "aval", None)
+            if getattr(aval, "dtype", None) == np.float64:  # graftlint: disable=GL004(dtype comparison constant — this IS the f64 detector)
+                sites.append(f"{eqn.primitive.name}: {aval}")
+        if (eqn.primitive.name == "convert_element_type"
+                and str(eqn.params.get("new_dtype", "")) == "float64"):
+            sites.append("convert_element_type -> float64")
+    return sites
+
+
+# --------------------------------------------------------------------------
+# tiny entry config (shared across entry points; built once per process)
+# --------------------------------------------------------------------------
+
+_TINY = dict(embedding_dim=16, vocab_size=32, word_embedding_dim=8,
+             text_hidden_dim=16, inception_blocks=1)
+_FRAMES, _SIZE, _WORDS = 4, 32, 5
+
+
+@functools.lru_cache(maxsize=1)
+def _setup():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from milnce_tpu.config import OptimConfig, ParallelConfig
+    from milnce_tpu.models import S3D
+    from milnce_tpu.parallel.mesh import build_mesh
+    from milnce_tpu.train.schedule import build_schedule
+    from milnce_tpu.train.state import build_optimizer, create_train_state
+
+    ndev = len(jax.devices())
+    assert ndev >= 2, (
+        "trace invariants need a multi-device mesh (run under the test "
+        "conftest or scripts/graft_lint.py, which force 8 virtual CPU "
+        f"devices); got {ndev}")
+    model = S3D(num_classes=_TINY["embedding_dim"],
+                vocab_size=_TINY["vocab_size"],
+                word_embedding_dim=_TINY["word_embedding_dim"],
+                text_hidden_dim=_TINY["text_hidden_dim"],
+                inception_blocks=_TINY["inception_blocks"])
+    b = 2 * ndev                      # 2 per shard: grad-cache can split M=2
+    variables = model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((2, _FRAMES, _SIZE, _SIZE, 3), jnp.float32),
+        jnp.zeros((2, _WORDS), jnp.int32))
+    opt = build_optimizer(OptimConfig(warmup_steps=2),
+                          build_schedule(OptimConfig(warmup_steps=2), 10))
+    state = create_train_state(variables, opt)
+    mesh = build_mesh(ParallelConfig())
+
+    def batch(seed: int = 0):
+        rng = np.random.default_rng(seed)
+        video = rng.integers(0, 255, (b, _FRAMES, _SIZE, _SIZE, 3),
+                             dtype=np.uint8)
+        text = rng.integers(0, _TINY["vocab_size"], (b, _WORDS)).astype(
+            np.int32)
+        start = np.zeros((b,), np.float32)
+        return video, text, start
+
+    return model, opt, mesh, state, batch
+
+
+def _jaxpr_checks(name: str, fn, args) -> list[CheckResult]:
+    import jax
+
+    jaxpr = jax.make_jaxpr(fn)(*args).jaxpr
+    bad = f64_sites(jaxpr)
+    got = collective_counts(jaxpr)
+    want = EXPECTED_COLLECTIVES[name]
+    return [
+        CheckResult(name, "no-f64", not bad,
+                    "; ".join(bad[:4]) if bad else ""),
+        CheckResult(name, "collectives", got == want,
+                    "" if got == want else f"expected {want}, traced {got} "
+                    "(communication structure changed — if intended, re-pin "
+                    "EXPECTED_COLLECTIVES)"),
+    ]
+
+
+def _recompile_check(name: str, fn, make_args, call=None) -> CheckResult:
+    """Execute twice with fresh same-shaped inputs; the second call must
+    hit the jit cache.  ``call`` adapts calling conventions."""
+    call = call or (lambda f, a: f(*a))
+    if not hasattr(fn, "_cache_size"):
+        return CheckResult(name, "recompile", True,
+                           "skipped: no _cache_size on this jax")
+    call(fn, make_args(0))
+    call(fn, make_args(1))
+    n = fn._cache_size()
+    return CheckResult(
+        name, "recompile", n == 1,
+        "" if n == 1 else f"{n} cache entries after two same-shape calls — "
+        "something retraces per call (weak-type or static-arg drift)")
+
+
+# --------------------------------------------------------------------------
+# entry points
+# --------------------------------------------------------------------------
+
+def _entry_train_step_milnce() -> list[CheckResult]:
+    from milnce_tpu.train.step import make_train_step
+
+    model, opt, mesh, state, batch = _setup()
+    step = make_train_step(model, opt, mesh, donate=False)
+    name = "train_step_milnce"
+    out = _jaxpr_checks(name, step, (state,) + batch())
+    out.append(_recompile_check(name, step,
+                                lambda s: (state,) + batch(s)))
+    return out
+
+
+def _entry_train_step_sdtw3() -> list[CheckResult]:
+    from milnce_tpu.config import LossConfig
+    from milnce_tpu.train.step import make_train_step
+
+    model, opt, mesh, state, batch = _setup()
+    step = make_train_step(model, opt, mesh, donate=False,
+                           loss_cfg=LossConfig(name="sdtw_3",
+                                               sdtw_backend="scan"))
+    return _jaxpr_checks("train_step_sdtw3", step, (state,) + batch())
+
+
+def _entry_grad_cache_step() -> list[CheckResult]:
+    from milnce_tpu.config import LossConfig
+    from milnce_tpu.train.step import make_grad_cache_step
+
+    model, opt, mesh, state, batch = _setup()
+    step = make_grad_cache_step(model, opt, mesh, 2, donate=False,
+                                loss_cfg=LossConfig(name="milnce"))
+    return _jaxpr_checks("grad_cache_step_milnce", step, (state,) + batch())
+
+
+def _entry_retrieval_embed() -> list[CheckResult]:
+    from milnce_tpu.train.step import (make_text_embed_fn,
+                                       make_video_embed_fn)
+
+    model, _opt, mesh, state, batch = _setup()
+    varz = {"params": state.params, "batch_stats": state.batch_stats}
+    vfn = make_video_embed_fn(model, mesh)
+    tfn = make_text_embed_fn(model, mesh)
+    out = _jaxpr_checks("video_embed", vfn, (varz, batch()[0]))
+    out += _jaxpr_checks("text_embed", tfn, (varz, batch()[1]))
+    out.append(_recompile_check("video_embed", vfn,
+                                lambda s: (varz, batch(s)[0])))
+    out.append(_recompile_check("text_embed", tfn,
+                                lambda s: (varz, batch(s)[1])))
+    return out
+
+
+def _entry_softdtw_scan() -> list[CheckResult]:
+    import jax
+    import numpy as np
+
+    from milnce_tpu.ops.softdtw import softdtw_scan
+
+    name = "softdtw_scan_grad"
+
+    def value(D, gamma):
+        return softdtw_scan(D, gamma).sum()
+
+    def make_D(seed):
+        return np.abs(np.random.default_rng(seed).standard_normal(
+            (4, 9, 7))).astype(np.float32)
+
+    grad_fn = jax.jit(jax.value_and_grad(value))
+    out = _jaxpr_checks(name, grad_fn, (make_D(0), np.float32(0.5)))
+    out.append(_recompile_check(
+        name, grad_fn, lambda s: (make_D(s), np.float32(0.5))))
+    return out
+
+
+def _entry_param_treedef() -> list[CheckResult]:
+    import jax
+    import jax.numpy as jnp
+
+    from milnce_tpu.config import CONV_IMPLS, ModelConfig
+    from milnce_tpu.models.build import build_model
+
+    shapes = {}
+    for impl in CONV_IMPLS:
+        m = build_model(ModelConfig(conv_impl=impl, **_TINY))
+        shapes[impl] = jax.eval_shape(
+            m.init, jax.random.PRNGKey(0),
+            jnp.zeros((2, _FRAMES, _SIZE, _SIZE, 3), jnp.float32),
+            jnp.zeros((2, _WORDS), jnp.int32))
+    ref_impl = CONV_IMPLS[0]
+    ref = shapes[ref_impl]
+    ref_td = jax.tree_util.tree_structure(ref)
+    ref_leaves = jax.tree_util.tree_leaves(ref)
+    out = []
+    for impl in CONV_IMPLS[1:]:
+        td = jax.tree_util.tree_structure(shapes[impl])
+        leaves = jax.tree_util.tree_leaves(shapes[impl])
+        same = (td == ref_td and len(leaves) == len(ref_leaves) and all(
+            a.shape == b.shape and a.dtype == b.dtype
+            for a, b in zip(leaves, ref_leaves)))
+        out.append(CheckResult(
+            "param_treedef", f"{ref_impl}-vs-{impl}", same,
+            "" if same else "param trees diverged — the per-stage impl map "
+            "and checkpoint portability both require identical layouts"))
+    return out
+
+
+ENTRY_POINTS = {
+    "train_step_milnce": _entry_train_step_milnce,
+    "train_step_sdtw3": _entry_train_step_sdtw3,
+    "grad_cache_step_milnce": _entry_grad_cache_step,
+    "retrieval_embed": _entry_retrieval_embed,
+    "softdtw_scan": _entry_softdtw_scan,
+    "param_treedef": _entry_param_treedef,
+}
+
+
+def run_trace_invariants(entries=None) -> list[CheckResult]:
+    """Run the invariant checks; entries=None runs all registered ones.
+    Builder exceptions become failing results, never crashes — the CLI
+    must always finish its report."""
+    results: list[CheckResult] = []
+    for name in (entries or ENTRY_POINTS):
+        try:
+            results.extend(ENTRY_POINTS[name]())
+        except Exception as exc:                    # pragma: no cover
+            results.append(CheckResult(name, "build", False,
+                                       f"{type(exc).__name__}: {exc}"))
+    return results

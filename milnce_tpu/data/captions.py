@@ -33,8 +33,12 @@ class CaptionTrack:
     def from_json_file(cls, path: str) -> "CaptionTrack":
         with open(path) as f:
             raw = json.load(f)
-        return cls(start=np.asarray(raw["start"], dtype=np.float64),
-                   end=np.asarray(raw["end"], dtype=np.float64),
+        # caption timestamps stay f64 on HOST: multi-hour videos at
+        # sub-frame precision exceed f32's ~1e-7 relative resolution;
+        # only the sampled clip-relative starts (small floats) ever
+        # reach the device, as f32.
+        return cls(start=np.asarray(raw["start"], dtype=np.float64),   # graftlint: disable=GL004(host-only timestamp precision; device sees clip-relative f32)
+                   end=np.asarray(raw["end"], dtype=np.float64),       # graftlint: disable=GL004(host-only timestamp precision; device sees clip-relative f32)
                    text=[str(t) for t in raw["text"]])
 
 

@@ -107,23 +107,30 @@ class ShardedLoader:
             pool.shutdown(wait=False, cancel_futures=True)
 
 
+def shard_placer(mesh: Mesh, axis: str = "data"):
+    """``x -> jax.Array`` explicitly placed sharded on dim 0 over the
+    mesh.  Multi-process: each host holds only ITS shard, so the global
+    array is assembled from process-local data —
+    ``device_put(local, sharding)`` would demand the same (global) value
+    on every process.  One definition for every hot-loop placement
+    (device_prefetch batches, train/loop.py's hoisted start fallback) so
+    the single-vs-multi-process branch can't silently diverge."""
+    sharding = NamedSharding(mesh, P(axis))
+    if jax.process_count() == 1:
+        return lambda x: jax.device_put(x, sharding)
+    return lambda x: jax.make_array_from_process_local_data(sharding, x)
+
+
 def device_prefetch(iterator: Iterator[dict], mesh: Mesh,
                     axis: str = "data", depth: int = 2) -> Iterator[dict]:
     """Keep ``depth`` batches in flight on device, sharded on dim 0.
     ``device_put`` is async, so this overlaps H2D transfer with compute.
 
-    Multi-process: each host holds only ITS batch shard, so the global
-    array is assembled from process-local data —
-    ``device_put(local, sharding)`` would demand the same (global) value
-    on every process.  The batch rows land in device order (process-
-    blocked) rather than the loader's strided index assignment; the
-    contrastive losses are row-permutation-invariant and video/text/
-    start shard identically, so pairing is preserved."""
-    sharding = NamedSharding(mesh, P(axis))
-    if jax.process_count() == 1:
-        place = lambda x: jax.device_put(x, sharding)
-    else:
-        place = lambda x: jax.make_array_from_process_local_data(sharding, x)
+    The batch rows land in device order (process-blocked) rather than
+    the loader's strided index assignment; the contrastive losses are
+    row-permutation-invariant and video/text/start shard identically, so
+    pairing is preserved."""
+    place = shard_placer(mesh, axis)
     put = lambda b: jax.tree_util.tree_map(place, b)
     queue = []
     for batch in iterator:

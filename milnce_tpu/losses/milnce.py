@@ -79,5 +79,15 @@ def milnce_loss(video_embd: jax.Array, text_embd: jax.Array,
 
     local_sum = jnp.sum(denominator - numerator)
     if axis_name is not None:
-        local_sum = lax.psum(local_sum, axis_name)
+        # Value: the mesh-global sum.  Gradient: identity to the LOCAL
+        # term only — jax versions disagree on the psum transpose when
+        # grad is taken inside the shard_map body (old jax overcounts
+        # the replicated cotangent by the axis size), so the reduction
+        # goes through the version-aware compat helper.  Both versions
+        # then agree with the unsharded reference once the train step
+        # psums the param grads
+        # (tests/test_milnce.py::test_sharded_gradients_match_unsharded).
+        from milnce_tpu.parallel.compat import psum_with_identity_grad
+
+        local_sum = psum_with_identity_grad(local_sum, axis_name)
     return local_sum / b_global

@@ -275,10 +275,15 @@ class CheckpointManager:
         template = jax.tree_util.tree_map(
             lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=shard)
             if is_arr(m) else m, meta, is_leaf=is_arr)
-        if subtrees is not None and isinstance(template, dict):
+        # older orbax has no PLACEHOLDER: fall back to restoring the full
+        # template — same values, just without the skipped-subtree I/O
+        # saving
+        placeholder = getattr(ocp, "PLACEHOLDER", None)
+        if (subtrees is not None and isinstance(template, dict)
+                and placeholder is not None):
             template = {
                 k: (v if k in subtrees else jax.tree_util.tree_map(
-                    lambda _: ocp.PLACEHOLDER, v, is_leaf=is_arr))
+                    lambda _: placeholder, v, is_leaf=is_arr))
                 for k, v in template.items()}
         return latest, self._mgr.restore(
             latest, args=ocp.args.StandardRestore(template))

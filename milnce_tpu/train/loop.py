@@ -23,12 +23,13 @@ import numpy as np
 
 from milnce_tpu.config import Config
 from milnce_tpu.data.pipeline import (ShardedLoader, device_prefetch,
-                                      flatten_text)
+                                      flatten_text, shard_placer)
 from milnce_tpu.data.synthetic import SyntheticVideoTextSource
 from milnce_tpu.models.build import build_model
-from milnce_tpu.parallel.mesh import build_mesh, initialize_distributed
+from milnce_tpu.parallel.mesh import (build_mesh, initialize_distributed,
+                                      replicate_to_mesh)
 from milnce_tpu.train.checkpoint import CheckpointManager
-from milnce_tpu.train.schedule import build_schedule
+from milnce_tpu.train.schedule import build_host_schedule, build_schedule
 from milnce_tpu.train.state import TrainState, build_optimizer, create_train_state
 from milnce_tpu.train.step import make_train_step
 from milnce_tpu.utils.logging import RunLogger
@@ -134,13 +135,6 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
     resume_skip = 0
     if cfg.train.resume:
         start_epoch, state = manager.restore_latest(state)
-        # restored arrays are committed to one device; re-replicate over
-        # the mesh (multihost-safe: assembles from process-local data
-        # instead of a cross-host device_put) so they compose with the
-        # batch-sharded step inputs
-        from milnce_tpu.parallel.mesh import replicate_to_mesh
-
-        state = replicate_to_mesh(state, mesh)
         # Mid-epoch checkpoints (preemption / max_steps) are labeled with
         # the CURRENT epoch; the restored step counter places us inside it,
         # and the loader skips the consumed batches at the index level so
@@ -150,6 +144,15 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
         resume_skip = int(state.step) % steps_per_epoch
         logger.log(f"resumed from epoch {start_epoch}"
                    + (f" at batch {resume_skip}" if resume_skip else ""))
+
+    # Explicitly replicate the state (freshly initialized OR restored —
+    # both land committed to one device) over the mesh NOW: leaving it
+    # single-device made the first step_fn call perform the re-replication
+    # as an IMPLICIT device-to-device transfer — invisible until the
+    # steady-state transfer guard flagged it.  Multihost-safe: assembles
+    # from process-local data instead of a cross-host device_put, so it
+    # composes with the batch-sharded step inputs.
+    state = replicate_to_mesh(state, mesh)
 
     if cfg.train.grad_accum > 1:
         from milnce_tpu.train.step import make_grad_cache_step
@@ -197,19 +200,47 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
     # would block the host on every step's completion and defeat the async
     # dispatch that device_prefetch exists to enable (the reference has the
     # same flaw implicitly — loss.item() per batch, main_distributed.py:212).
-    # Host transfer happens only every ``n_display`` steps and at exit.
+    # Host transfer happens only every ``n_display`` steps and at exit, and
+    # the steady state runs under ``jax.transfer_guard("disallow")`` so a
+    # smuggled implicit sync RAISES instead of silently stalling the
+    # pipeline (tests/test_transfer_guard.py); the display/checkpoint
+    # branches re-enter "allow" — the audited escape hatch.
     total_steps = 0
     last_loss_dev = None
     running_dev = None
     window = 0
     timer = StepTimer(clips_per_step=cfg.train.batch_size)
+    # Wall clock feeds the human-facing elapsed display only; bench numbers
+    # come from utils/timing.py's differenced protocol.
+    # graftlint: disable=GL005(elapsed-display only; the windowed loss fetch at the same cadence is the device sync)
     tick = time.time()
 
+    # Step counter tracked ON HOST: state.step is a device scalar, and
+    # reading it back (int(state.step)) at display/stop cadence was a
+    # hidden sync — graftlint GL001.  The restored value is read ONCE
+    # here; afterwards host arithmetic stays exact.
+    opt_step0 = int(state.step)
+
+    # LR display comes from the numpy twin of the device schedule:
+    # float(schedule(step)) of the jnp form was a per-display device
+    # round-trip (the original graftlint finding this PR fixes).
+    host_schedule = build_host_schedule(cfg.optim, steps_per_epoch)
+
+    # Hoisted fallback for sources without per-clip start times: building
+    # np.zeros INSIDE the loop fed the jitted step an implicit H2D
+    # transfer every step.  Placed once, explicitly, mesh-sharded via the
+    # same placement helper the prefetcher uses.
+    zero_start = shard_placer(mesh, axis)(
+        np.zeros((cfg.train.batch_size // jax.process_count(),),
+                 np.float32))
+
     def fetch(dev_val) -> float:
-        return (float(jax.device_get(dev_val))
+        # the ONE audited transfer of the display path (off-cadence by
+        # design; see the n_display branch)
+        return (float(jax.device_get(dev_val))  # graftlint: disable=GL001(display/exit-cadence fetch of the windowed loss — the deliberate sync point, not a per-step one)
                 if dev_val is not None else float("nan"))
 
-    def check_finite(mean_loss: float) -> None:
+    def check_finite(mean_loss: float, step_label: int) -> None:
         """Divergence guard, evaluated only at display fetches (no extra
         host syncs): a non-finite windowed loss snapshots the run state
         for post-mortem and halts instead of burning the rest of the
@@ -222,7 +253,6 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
         ``--resume``, which restores from the rotation only."""
         if np.isfinite(mean_loss) or not cfg.train.halt_on_nan:
             return
-        step_label = int(state.step)
         pm = CheckpointManager(os.path.join(ckpt_dir, "nan_postmortem"),
                                keep=1)
         pm.save(step_label, state)
@@ -235,17 +265,23 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
 
     try:
       with maybe_trace(cfg.train.trace_dir or None):
-        for epoch in range(start_epoch, cfg.optim.epochs):
+        # Steady state: IMPLICIT device transfers are a bug (a hidden
+        # host sync or a per-step H2D upload) and raise immediately.
+        # Explicit device_put/device_get stay legal; the display /
+        # preemption-sync / checkpoint branches re-enter "allow" — every
+        # escape hatch is a deliberate, cadenced one.
+        with jax.transfer_guard("disallow"):
+          for epoch in range(start_epoch, cfg.optim.epochs):
             if (cfg.train.evaluate and cfg.data.eval_video_root
                     and epoch % eval_every == 0):
-                _in_training_eval(cfg, model, state, mesh, logger)
+                with jax.transfer_guard("allow"):   # epoch-cadence eval
+                    _in_training_eval(cfg, model, state, mesh, logger)
             skip = resume_skip if epoch == start_epoch else 0
             for batch in device_prefetch(loader.epoch(epoch, skip_batches=skip),
                                          mesh, axis,
                                          depth=cfg.data.prefetch_depth):
                 video, text = flatten_text(batch)
-                start = batch.get(
-                    "start", np.zeros((video.shape[0],), np.float32))
+                start = batch.get("start", zero_start)
                 state, loss = step_fn(state, video, text, start)
                 total_steps += 1
                 window += 1
@@ -254,11 +290,13 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                 running_dev = loss if running_dev is None else running_dev + loss
                 last_loss_dev = loss
                 if window % cfg.train.n_display == 0:
-                    # LR + progress from the RESTORED step counter, so they
-                    # stay correct across resumes.
-                    opt_step = int(state.step)
-                    lr = float(schedule(opt_step))
-                    progress = (opt_step % steps_per_epoch) / steps_per_epoch
+                  # LR + progress from the host step counter (seeded by
+                  # the RESTORED device counter once, before the loop),
+                  # so they stay correct across resumes with no sync.
+                  opt_step = opt_step0 + total_steps
+                  lr = host_schedule(opt_step)
+                  progress = (opt_step % steps_per_epoch) / steps_per_epoch
+                  with jax.transfer_guard("allow"):  # display-cadence fetch
                     mean_loss = fetch(running_dev) / window
                     logger.log(
                         f"Epoch {epoch + 1}, Elapsed Time: "
@@ -267,21 +305,26 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                         f"{mean_loss:.4f}, "
                         f"Learning rate: {lr:.6f}, Throughput: "
                         f"{timer.clips_per_sec:.1f} clips/s")
-                    check_finite(mean_loss)
-                    running_dev = None
-                    window = 0
-                    timer.reset()
-                    tick = time.time()
+                    check_finite(mean_loss, opt_step)
+                  running_dev = None
+                  window = 0
+                  timer.reset()
+                  tick = time.time()
                 if multi:
                     # every process evaluates the collective at the SAME
                     # steps (total_steps advances in lockstep), so they
-                    # all see the same verdict
-                    stopping = (total_steps % sync_every == 0
-                                and any_preempted(preempted["flag"]))
+                    # all see the same verdict.  The guard escape opens
+                    # only on the cadence hit — the 1-in-sync_every step
+                    # where the reducer materializes its verdict on host.
+                    stopping = False
+                    if total_steps % sync_every == 0:
+                        with jax.transfer_guard("allow"):
+                            stopping = any_preempted(preempted["flag"])
                 else:
                     stopping = preempted["flag"]
                 if stopping or (max_steps is not None
                                 and total_steps >= max_steps):
+                  with jax.transfer_guard("allow"):  # checkpoint + exit
                     if stopping:
                         logger.log("SIGTERM — checkpointing and exiting"
                                    + (" (cluster-coordinated)" if multi
@@ -295,13 +338,14 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                     # boundary save holds the same label and Orbax would
                     # otherwise silently skip this save, losing the
                     # partial epoch (see CheckpointManager.save).
-                    done = int(state.step) % steps_per_epoch == 0
+                    done = (opt_step0 + total_steps) % steps_per_epoch == 0
                     manager.save(epoch + 1 if done else epoch, state,
                                  force=not done)
                     manager.wait()
                     return TrainResult(state, total_steps,
                                        fetch(last_loss_dev))
-            manager.save(epoch + 1, state)
+            with jax.transfer_guard("allow"):       # epoch-boundary save
+                manager.save(epoch + 1, state)
     finally:
         manager.wait()
         if prev_handler is not None:

@@ -4,29 +4,54 @@ Cosine-with-warmup matching the reference (utils.py:26-38): linear warmup
 over ``num_warmup_steps`` then ``max(0, 0.5*(1 + cos(pi * num_cycles * 2 *
 progress)))``, stepped PER BATCH (main_distributed.py:240).  Expressed as
 an optax schedule (pure fn of the step) instead of a stateful LambdaLR.
+
+The schedule exists in two evaluation modes sharing one formula:
+
+- ``xp=jnp`` (default): traced into the optimizer via
+  ``optax.inject_hyperparams`` — lives on device with the step;
+- ``xp=np`` (via :func:`build_host_schedule`): evaluated with numpy on
+  the HOST for log-cadence LR display.  ``float(schedule(step))`` of the
+  device form blocks the host on the device stream (graftlint GL001 —
+  the finding that motivated this split); the numpy twin costs
+  nanoseconds and keeps the steady-state ``transfer_guard`` airtight.
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from milnce_tpu.config import OptimConfig
 
 
 def cosine_with_warmup(base_lr: float, num_warmup_steps: int,
-                       num_training_steps: int, num_cycles: float = 0.5):
+                       num_training_steps: int, num_cycles: float = 0.5,
+                       xp=jnp):
     def schedule(step):
-        step = jnp.asarray(step, jnp.float32)
-        warmup = step / jnp.maximum(1.0, num_warmup_steps)
-        progress = (step - num_warmup_steps) / jnp.maximum(
+        step = xp.asarray(step, xp.float32)
+        warmup = step / xp.maximum(1.0, num_warmup_steps)
+        progress = (step - num_warmup_steps) / xp.maximum(
             1.0, num_training_steps - num_warmup_steps)
-        cosine = jnp.maximum(
-            0.0, 0.5 * (1.0 + jnp.cos(jnp.pi * num_cycles * 2.0 * progress)))
-        return base_lr * jnp.where(step < num_warmup_steps, warmup, cosine)
+        cosine = xp.maximum(
+            0.0, 0.5 * (1.0 + xp.cos(xp.pi * num_cycles * 2.0 * progress)))
+        return base_lr * xp.where(step < num_warmup_steps, warmup, cosine)
 
     return schedule
 
 
-def build_schedule(cfg: OptimConfig, steps_per_epoch: int):
+def build_schedule(cfg: OptimConfig, steps_per_epoch: int, xp=jnp):
     total = steps_per_epoch * cfg.epochs
-    return cosine_with_warmup(cfg.lr, cfg.warmup_steps, total, cfg.num_cycles)
+    return cosine_with_warmup(cfg.lr, cfg.warmup_steps, total,
+                              cfg.num_cycles, xp=xp)
+
+
+def build_host_schedule(cfg: OptimConfig, steps_per_epoch: int):
+    """``step -> float`` twin of :func:`build_schedule` computed entirely
+    with numpy — no device values touched, so the hot loop's LR display
+    never blocks (and never trips the steady-state transfer guard)."""
+    sched = build_schedule(cfg, steps_per_epoch, xp=np)
+
+    def host_schedule(step: int) -> float:
+        return float(sched(step))
+
+    return host_schedule

@@ -68,6 +68,8 @@ def create_train_state(variables, optimizer) -> TrainState:
     )
 
 
-def current_lr(state: TrainState) -> float:
-    """Read the LR that the last/next step uses (for n_display logging)."""
-    return float(state.opt_state.hyperparams["learning_rate"])
+# NOTE: the old ``current_lr(state)`` helper (read the injected
+# hyperparam back from DEVICE) is gone: it was a host sync by
+# construction and had no remaining callers — LR display everywhere
+# uses the numpy host schedule (train/schedule.py build_host_schedule),
+# which never touches device state.

@@ -29,6 +29,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from milnce_tpu.losses.milnce import milnce_loss
+from milnce_tpu.parallel.compat import donation_argnums, shard_map
 from milnce_tpu.train.state import TrainState
 
 
@@ -191,13 +192,13 @@ def make_grad_cache_step(model, optimizer, mesh: Mesh,
         return TrainState(step=state.step + 1, params=new_params,
                           batch_stats=new_stats, opt_state=new_opt), loss
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_step, mesh=mesh,
         in_specs=(P(), P(data_axis), P(data_axis), P(data_axis)),
         out_specs=(P(), P()),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    return jax.jit(sharded, donate_argnums=donation_argnums(0) if donate else ())
 
 
 def make_train_step(model, optimizer, mesh: Mesh, data_axis: str = "data",
@@ -271,13 +272,13 @@ def make_train_step(model, optimizer, mesh: Mesh, data_axis: str = "data",
     else:
         local_fn = local_step
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_fn, mesh=mesh,
         in_specs=(P(), P(data_axis), P(data_axis), P(data_axis)),
         out_specs=(P(), P()),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    return jax.jit(sharded, donate_argnums=donation_argnums(0) if donate else ())
 
 
 def make_video_embed_fn(model, mesh: Mesh, data_axis: str = "data",
@@ -292,7 +293,7 @@ def make_video_embed_fn(model, mesh: Mesh, data_axis: str = "data",
         return model.apply(variables, video, None, mode="video",
                            mixed5c=mixed5c)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         local, mesh=mesh, in_specs=(P(), P(data_axis)),
         out_specs=P(data_axis), check_vma=False))
 
@@ -301,6 +302,6 @@ def make_text_embed_fn(model, mesh: Mesh, data_axis: str = "data"):
     def local(variables, text_ids):
         return model.apply(variables, None, text_ids, mode="text")
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         local, mesh=mesh, in_specs=(P(), P(data_axis)),
         out_specs=P(data_axis), check_vma=False))

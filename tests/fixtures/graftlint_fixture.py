@@ -1,0 +1,92 @@
+"""graftlint test fixture — LINTED AS SOURCE, NEVER IMPORTED.
+
+Every rule is violated exactly once unsuppressed, and once more under an
+inline suppression, so tests/test_graftlint.py can pin EXACT per-rule
+finding counts (a lint whose counts drift is a lint nobody trusts).
+Two malformed suppressions at the bottom pin the GL000 meta-rule.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---- GL002 traced-python-flow ------------------------------------------
+
+@jax.jit
+def traced_flow(x):
+    if x > 0:                       # GL002: Python `if` on a tracer
+        y = x
+    else:
+        y = -x
+    for _ in x:  # graftlint: disable=GL002(fixture: the audited suppressed occurrence)
+        y = y + 1
+    return y
+
+
+# ---- GL006 print-under-trace -------------------------------------------
+
+@jax.jit
+def traced_print(x):
+    print("tracing", x)             # GL006: fires once, shows tracers
+    return jnp.sum(x)
+
+
+@jax.jit
+def traced_print_suppressed(x):
+    print("known")  # graftlint: disable=GL006(fixture: the audited suppressed occurrence)
+    return x
+
+
+# ---- GL001 host-sync-hot-loop ------------------------------------------
+
+def hot_loop(loader, mesh, step_fn, state):
+    from milnce_tpu.data.pipeline import device_prefetch
+
+    for batch in device_prefetch(loader, mesh, "data"):
+        state, loss = step_fn(state, batch)
+        lv = float(loss)            # GL001: per-step host sync
+        _ = jax.device_get(loss)  # graftlint: disable=GL001(fixture: the audited suppressed occurrence)
+        del lv
+    return state
+
+
+# ---- GL003 jit-missing-donate ------------------------------------------
+
+def train_step(state, batch):
+    return state
+
+
+jitted_bad = jax.jit(train_step)    # GL003: no donate_argnums
+jitted_ok = jax.jit(train_step, donate_argnums=(0,))
+jitted_sup = jax.jit(train_step)  # graftlint: disable=GL003(fixture: the audited suppressed occurrence)
+
+
+# ---- GL004 f64-literal-drift -------------------------------------------
+
+bad_pad = jnp.asarray(0.5)          # GL004: f64 under x64
+ok_pad = jnp.asarray(0.5, jnp.float32)
+sup_pad = np.zeros((4,))  # graftlint: disable=GL004(fixture: the audited suppressed occurrence)
+
+
+# ---- GL005 unsynced-walltime -------------------------------------------
+
+def naive_timing(f, x):
+    t0 = time.time()                # GL005: measures enqueue, not work
+    f(x)
+    return time.time() - t0
+
+
+def audited_timing(f, x):
+    # graftlint: disable=GL005(fixture: the audited suppressed occurrence)
+    t0 = time.perf_counter()
+    f(x)
+    return time.perf_counter() - t0
+
+
+# ---- GL000 bad-suppression ---------------------------------------------
+
+x_no_reason = 1  # graftlint: disable=GL001
+x_unknown_rule = 2  # graftlint: disable=GL999(no such rule)

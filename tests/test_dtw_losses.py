@@ -6,6 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from milnce_tpu.parallel.compat import shard_map
 from milnce_tpu.losses.dtw_losses import (cdtw_loss, sdtw_3_loss,
                                           sdtw_cidm_loss, sdtw_negative_loss)
 
@@ -106,7 +107,7 @@ def test_sequence_loss_threads_config_knobs():
     mesh = Mesh(np.asarray(_jax.devices()), ("data",))
 
     def run(cfg):
-        fn = _jax.shard_map(
+        fn = shard_map(
             lambda a, b_, s: _sequence_loss(cfg, a, b_, s, "data"),
             mesh=mesh, in_specs=(P("data"), P("data"), P("data")),
             out_specs=P(), check_vma=False)
@@ -134,7 +135,7 @@ def test_sequence_loss_per_loss_gamma_defaults():
     mesh = Mesh(np.asarray(_jax.devices()), ("data",))
 
     def run(cfg):
-        fn = _jax.shard_map(
+        fn = shard_map(
             lambda a, b_, s: _sequence_loss(cfg, a, b_, s, "data"),
             mesh=mesh, in_specs=(P("data"), P("data"), P("data")),
             out_specs=P(), check_vma=False)

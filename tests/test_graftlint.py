@@ -1,0 +1,165 @@
+"""graftlint Pass 1 gates: exact fixture counts, suppression syntax, and
+the repo-wide clean bill.
+
+The repo-clean test is the actual CI gate the tentpole exists for: a new
+hot-path pothole (host sync in the loop, f64 drift, undonated train-step
+jit, ...) lands as a FAILING tier-1 test, not as a TPU-session surprise
+weeks later.  The fixture tests pin the linter itself — rules that
+silently stop firing are worse than no rules.
+"""
+
+import os
+import subprocess
+import sys
+from collections import Counter
+
+import pytest
+
+from milnce_tpu.analysis.astlint import lint_paths, lint_source
+from milnce_tpu.analysis.rules import RULES, RULES_BY_NAME
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures", "graftlint_fixture.py")
+
+
+def _fixture_findings():
+    with open(_FIXTURE) as fh:
+        return lint_source(fh.read(), _FIXTURE)
+
+
+def test_fixture_violates_every_rule_exactly_once():
+    active = Counter(f.rule.id for f in _fixture_findings()
+                     if not f.suppressed)
+    assert active == {
+        "GL000": 2,       # missing reason + unknown rule
+        "GL001": 1, "GL002": 1, "GL003": 1,
+        "GL004": 1, "GL005": 1, "GL006": 1,
+    }, f"per-rule finding counts drifted: {dict(active)}"
+
+
+def test_fixture_suppresses_every_rule_exactly_once():
+    suppressed = [f for f in _fixture_findings() if f.suppressed]
+    counts = Counter(f.rule.id for f in suppressed)
+    assert counts == {"GL001": 1, "GL002": 1, "GL003": 1,
+                      "GL004": 1, "GL005": 1, "GL006": 1}, (
+        f"suppressed counts drifted: {dict(counts)}")
+    assert all(f.suppress_reason for f in suppressed), (
+        "suppressed findings must carry their audit reason")
+
+
+def test_suppression_without_reason_is_gl000():
+    findings = lint_source("y = 1  # graftlint: disable=GL004\n")
+    assert [f.rule.id for f in findings] == ["GL000"]
+    assert "no reason" in findings[0].message
+
+
+def test_unknown_rule_in_suppression_is_gl000():
+    findings = lint_source("y = 1  # graftlint: disable=GL123(whatever)\n")
+    assert [f.rule.id for f in findings] == ["GL000"]
+
+
+def test_suppression_accepts_rule_names():
+    src = ("import jax.numpy as jnp\n"
+           "pad = jnp.asarray(0.5)  "
+           "# graftlint: disable=f64-literal-drift(name-addressed)\n")
+    (finding,) = lint_source(src)
+    assert finding.rule.id == "GL004" and finding.suppressed
+    assert finding.suppress_reason == "name-addressed"
+
+
+def test_standalone_suppression_covers_next_line():
+    src = ("import jax.numpy as jnp\n"
+           "# graftlint: disable=GL004(own-line comment form)\n"
+           "pad = jnp.asarray(0.5)\n")
+    (finding,) = lint_source(src)
+    assert finding.suppressed
+
+
+def test_docstrings_mentioning_the_syntax_do_not_parse_as_suppressions():
+    src = '"""Docs: write # graftlint: disable=GL001(reason) inline."""\n'
+    assert lint_source(src) == []
+
+
+def test_rule_registry_is_consistent():
+    assert set(RULES) == {"GL000", "GL001", "GL002", "GL003", "GL004",
+                          "GL005", "GL006"}
+    assert len(RULES_BY_NAME) == len(RULES), "duplicate rule names"
+    for rule in RULES.values():
+        assert rule.summary and rule.rationale and rule.fix
+
+
+def test_duplicate_nested_names_are_all_linted():
+    """Two factories each defining `def local(x)` (the train/step.py
+    pattern): EVERY same-named def must be linted, not just the first
+    (code-review r7 finding — the second body shipped unchecked)."""
+    src = (
+        "import jax\n"
+        "def make_a():\n"
+        "    def local(x):\n"
+        "        return x\n"
+        "    return jax.jit(local)\n"
+        "def make_b():\n"
+        "    def local(x):\n"
+        "        if x > 0:\n"
+        "            print('hot', x)\n"
+        "        return x\n"
+        "    return jax.jit(local)\n")
+    ids = [f.rule.id for f in lint_source(src)]
+    assert "GL002" in ids and "GL006" in ids, ids
+
+
+def test_method_form_block_until_ready_flagged_in_hot_loop():
+    """x.block_until_ready() per step is the same stall as the function
+    form and must not slip past GL001 (code-review r7 finding)."""
+    src = (
+        "import jax\n"
+        "def run(loader, mesh, step_fn, state):\n"
+        "    from milnce_tpu.data.pipeline import device_prefetch\n"
+        "    for batch in device_prefetch(loader, mesh, 'data'):\n"
+        "        state, loss = step_fn(state, batch)\n"
+        "        loss.block_until_ready()\n"
+        "    return state\n")
+    assert any(f.rule.id == "GL001" and "block_until_ready" in f.message
+               for f in lint_source(src))
+
+
+def test_repo_hot_path_lints_clean():
+    """The merge gate: every finding in the package is either fixed or
+    carries a reasoned inline suppression (the audited exceptions)."""
+    findings = lint_paths([os.path.join(_REPO, "milnce_tpu")])
+    active = [f.format() for f in findings if not f.suppressed]
+    assert not active, (
+        "new graftlint findings — fix them or add a reasoned "
+        "# graftlint: disable=RULE(reason):\n" + "\n".join(active))
+    # the audited exceptions exist and all carry reasons
+    suppressed = [f for f in findings if f.suppressed]
+    assert suppressed, "expected the documented audited exceptions"
+    assert all(f.suppress_reason for f in suppressed)
+
+
+def test_lint_paths_rejects_scope_matching_no_files(tmp_path):
+    """A typo'd scope must fail loudly, not pass the gate vacuously."""
+    with pytest.raises(FileNotFoundError, match="matches no Python"):
+        lint_paths([str(tmp_path / "no_such_dir")])
+
+
+def test_cli_check_exits_zero_on_clean_repo():
+    """`scripts/graft_lint.py --check` is the CI/tooling entry (AST pass;
+    the trace pass is gated in-process by test_trace_invariants.py)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "graft_lint.py"),
+         "--check", "--no-trace", "--report", ""],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_check_exits_nonzero_on_findings(tmp_path):
+    bad = tmp_path / "bad_module.py"
+    bad.write_text("import jax.numpy as jnp\npad = jnp.asarray(0.5)\n")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "graft_lint.py"),
+         "--check", "--no-trace", "--report", "", str(bad)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "GL004" in proc.stdout

@@ -9,6 +9,7 @@ import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from milnce_tpu.losses.milnce import milnce_loss
+from milnce_tpu.parallel.compat import set_mesh, shard_map
 
 
 def numpy_milnce(v, t):
@@ -59,11 +60,11 @@ def test_sharded_equals_unsharded():
 
     @jax.jit
     def sharded(v, t):
-        return jax.shard_map(
+        return shard_map(
             lambda vv, tt: milnce_loss(vv, tt, axis_name="data"),
             mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P())(v, t)
 
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         out = sharded(jax.device_put(v, NamedSharding(mesh, P("data"))),
                       jax.device_put(t, NamedSharding(mesh, P("data"))))
     np.testing.assert_allclose(float(out), numpy_milnce(v, t), rtol=1e-5)
@@ -88,11 +89,11 @@ def test_sharded_gradients_match_unsharded():
                 lambda a, b_: milnce_loss(a, b_, axis_name="data"),
                 argnums=(0, 1))(vv, tt)
             return gv, gt
-        return jax.shard_map(local, mesh=mesh,
+        return shard_map(local, mesh=mesh,
                              in_specs=(P("data"), P("data")),
                              out_specs=(P("data"), P("data")))(v, t)
 
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         gv, gt = sharded_grads(jax.device_put(v, NamedSharding(mesh, P("data"))),
                                jax.device_put(t, NamedSharding(mesh, P("data"))))
     np.testing.assert_allclose(np.asarray(gv), np.asarray(ref_grad_v),
@@ -114,7 +115,7 @@ def test_per_chip_memory_at_baseline_scale():
 
     @jax.jit
     def sharded(v, t):
-        return jax.shard_map(
+        return shard_map(
             lambda vv, tt: milnce_loss(vv, tt, axis_name="data"),
             mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P())(v, t)
 
@@ -122,7 +123,7 @@ def test_per_chip_memory_at_baseline_scale():
                              sharding=NamedSharding(mesh, P("data")))
     t = jax.ShapeDtypeStruct((bg * k, d), jnp.float32,
                              sharding=NamedSharding(mesh, P("data")))
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         stats = sharded.lower(v, t).compile().memory_analysis()
     cube = b_local * bg * k * 4                      # one (B_local, Bg, K) f32
     # temp budget: rows + cols cubes + reduction scratch; flag anything

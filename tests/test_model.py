@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 from milnce_tpu.models import S3D
+from milnce_tpu.parallel.compat import set_mesh, shard_map
 from milnce_tpu.models.s3dg import space_to_depth, _tf_same_max_pool
 
 
@@ -157,10 +158,10 @@ def test_sync_batchnorm_merges_stats_across_shards():
                                 mutable=["batch_stats"])
             return mut["batch_stats"]
 
-        return jax.shard_map(local, mesh=mesh, in_specs=P("data"),
+        return shard_map(local, mesh=mesh, in_specs=P("data"),
                              out_specs=P(), check_vma=False)(x)
 
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         stats_sharded = sharded_stats(
             jax.device_put(x, NamedSharding(mesh, P("data"))))
 

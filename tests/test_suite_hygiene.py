@@ -82,6 +82,7 @@ _REPORT_GENERATORS = {
     "STAGE_AUTOTUNE.md": "scripts/stage_probe.py",
     "XLA_FLAGS_PROBE.md": "scripts/xla_flag_probe.py",
     "DATA_BENCH.md": "scripts/data_bench.py",
+    "LINT.md": "scripts/graft_lint.py",
 }
 
 
@@ -111,11 +112,36 @@ def test_report_writers_emit_generator_headers():
             "auto-written by scripts/xla_flag_probe.py",
         os.path.join(_REPO, "scripts", "data_bench.py"):
             "auto-written by scripts/data_bench.py",
+        # LINT.md's renderer lives in the package; the header still names
+        # the CLI that users run
+        os.path.join(_REPO, "milnce_tpu", "analysis", "report.py"):
+            "auto-written by scripts/graft_lint.py",
     }
     for path, header in writers.items():
         assert header in open(path).read(), (
             f"{os.path.basename(path)} writes a report without naming "
             f"itself ('{header}')")
+
+
+# graftlint gate tests (ISSUE 2): the static-analysis + trace-invariant
+# layer only guards the hot path if it runs on EVERY default `pytest`
+# invocation — a slow-marked (or vanished) gate ships regressions.
+_ANALYSIS_GATES = ("test_graftlint.py", "test_trace_invariants.py",
+                   "test_transfer_guard.py")
+
+
+def test_analysis_gates_exist_and_stay_tier1():
+    for fname in _ANALYSIS_GATES:
+        path = os.path.join(_TESTS, fname)
+        assert os.path.exists(path), f"analysis gate {fname} is missing"
+        src = open(path).read()
+        tests = list(_iter_tests(ast.parse(src)))
+        assert tests, f"{fname} defines no tests"
+        slow = [node.name for node, class_slow in tests
+                if _is_slow_marked(node, class_slow)]
+        assert not slow, (
+            "graftlint gates must be tier-1/CPU-safe, never @slow "
+            f"(they ARE the fast regression fence): {fname}::{slow}")
 
 
 def test_autotune_artifact_carries_generator_key():

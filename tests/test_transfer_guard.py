@@ -1,0 +1,55 @@
+"""Steady-state transfer-guard gates (ISSUE 2 satellite).
+
+``run_training`` wraps its hot loop in ``jax.transfer_guard("disallow")``
+with audited escape hatches at display/preemption/checkpoint cadence.
+The negative test smuggles an implicit host sync into the loop body and
+asserts the guard turns it into an immediate error (instead of a silent
+per-step pipeline stall — the failure mode PR 1's throughput work can't
+survive).  The positive test proves the legitimate path still trains:
+every remaining transfer in the steady state is explicit or cadenced.
+"""
+
+import numpy as np
+import pytest
+
+from milnce_tpu.config import tiny_preset
+
+
+def _tiny_cfg(tmp_path):
+    cfg = tiny_preset()
+    cfg.model.inception_blocks = 1       # 1-block S3D: tier-1 compile time
+    cfg.train.batch_size = 8
+    cfg.data.synthetic_num_samples = 16
+    cfg.data.num_reader_threads = 2
+    cfg.train.checkpoint_root = str(tmp_path / "ckpt")
+    cfg.train.log_root = str(tmp_path / "log")
+    return cfg
+
+
+def test_smuggled_host_sync_raises(tmp_path, monkeypatch):
+    """Re-introduce the pre-fix pothole: a HOST numpy array built per
+    step and fed to the jitted step forces an implicit H2D transfer
+    every iteration (this is literally what the un-hoisted np.zeros
+    ``start`` fallback used to do).  The steady-state guard must turn
+    it into an immediate error.  (On the CPU test backend implicit D2H
+    is zero-copy and unguardable; implicit H2D into the committed,
+    mesh-sharded step inputs is the guarded class on every backend.)"""
+    import milnce_tpu.train.loop as loop_mod
+
+    real_flatten = loop_mod.flatten_text
+
+    def smuggled(batch):
+        video, text = real_flatten(batch)
+        return video, np.asarray(text)     # host copy -> implicit H2D
+
+    monkeypatch.setattr(loop_mod, "flatten_text", smuggled)
+    with pytest.raises(Exception, match="[Dd]isallowed"):
+        loop_mod.run_training(_tiny_cfg(tmp_path), max_steps=1)
+
+
+def test_clean_run_trains_under_guard(tmp_path):
+    from milnce_tpu.train.loop import run_training
+
+    res = run_training(_tiny_cfg(tmp_path), max_steps=2)
+    assert res.steps == 2
+    assert np.isfinite(res.last_loss)
