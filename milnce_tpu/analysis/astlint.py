@@ -518,6 +518,47 @@ class _ModuleLint:
                            "with no block_until_ready — async dispatch makes "
                            "the delta measure enqueue, not device work")
 
+    # ---- GL007: swallowed broad except -----------------------------------
+
+    _BROAD_EXC = {"Exception", "BaseException"}
+
+    @classmethod
+    def _is_broad_handler(cls, handler: ast.ExceptHandler) -> bool:
+        if handler.type is None:                    # bare `except:`
+            return True
+        for node in ast.walk(handler.type):
+            name = (node.id if isinstance(node, ast.Name)
+                    else node.attr if isinstance(node, ast.Attribute)
+                    else None)
+            if name in cls._BROAD_EXC:
+                return True
+        return False
+
+    def check_broad_except(self) -> None:
+        """A broad handler must visibly DO something with the failure:
+        re-raise, reference the bound exception (log/record/wrap it), or
+        pass ``exc_info`` to a logging call.  Anything else is a silent
+        swallow — exactly the class of 'handling' that turns a broken
+        dataset or flaky store into a green-looking run."""
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.ExceptHandler)
+                    and self._is_broad_handler(node)):
+                continue
+            body_nodes = [n for stmt in node.body for n in ast.walk(stmt)]
+            reraises = any(isinstance(n, ast.Raise) for n in body_nodes)
+            uses_exc = node.name is not None and any(
+                isinstance(n, ast.Name) and n.id == node.name
+                for n in body_nodes)
+            logs_exc_info = any(
+                isinstance(n, ast.Call)
+                and any(kw.arg == "exc_info" for kw in n.keywords)
+                for n in body_nodes)
+            if not (reraises or uses_exc or logs_exc_info):
+                self._emit("GL007", node,
+                           "broad except swallows the error — re-raise, "
+                           "record the bound exception, or add a reasoned "
+                           "suppression")
+
     # ---- driver ----------------------------------------------------------
 
     def run(self) -> list[Finding]:
@@ -526,6 +567,7 @@ class _ModuleLint:
         self.check_donation()
         self.check_f64()
         self.check_timing()
+        self.check_broad_except()
         return self.findings
 
 

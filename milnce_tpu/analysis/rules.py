@@ -116,6 +116,21 @@ _RULE_LIST = (
         fix="jax.debug.print for traced values; host-side logging belongs "
             "outside the step at display cadence",
     ),
+    Rule(
+        id="GL007",
+        name="swallowed-broad-except",
+        summary="broad `except` that drops the error on the floor",
+        rationale="A bare/`except Exception:` handler that neither "
+                  "re-raises nor records the caught exception (uses the "
+                  "bound name, logs with exc_info) swallows failures "
+                  "silently — at pod scale that is how a 90%-corrupt "
+                  "dataset 'trains' green and how a flaky checkpoint "
+                  "store loses an epoch without a log line.",
+        example="except Exception:\n    pass",
+        fix="re-raise, bind and use the exception (log/record it), pass "
+            "exc_info to a logging call, or suppress with a reason: "
+            "# graftlint: disable=GL007(<why swallowing is correct here>)",
+    ),
 )
 
 RULES: dict[str, Rule] = {r.id: r for r in _RULE_LIST}

@@ -48,6 +48,11 @@ COLLECTIVES = ("psum", "all_gather", "psum_scatter", "all_to_all",
 # one psum for a third all_gather (clip start-times feed the alignment).
 EXPECTED_COLLECTIVES = {
     "train_step_milnce": {"all_gather": 2, "psum": 26},
+    # the finite-update guard (ISSUE 3) must add NO collectives and no
+    # host sync: its all-finite check runs on the already-psum'd
+    # (replicated) grads and the skip is a jnp.where select — the pin
+    # being IDENTICAL to the unguarded step is the invariant
+    "train_step_milnce_guarded": {"all_gather": 2, "psum": 26},
     "train_step_sdtw3": {"all_gather": 3, "psum": 25},
     "grad_cache_step_milnce": {"all_gather": 2, "psum": 26},
     "video_embed": {},
@@ -215,6 +220,18 @@ def _entry_train_step_milnce() -> list[CheckResult]:
     return out
 
 
+def _entry_train_step_milnce_guarded() -> list[CheckResult]:
+    from milnce_tpu.train.step import make_train_step
+
+    model, opt, mesh, state, batch = _setup()
+    step = make_train_step(model, opt, mesh, donate=False, finite_guard=True)
+    name = "train_step_milnce_guarded"
+    out = _jaxpr_checks(name, step, (state,) + batch())
+    out.append(_recompile_check(name, step,
+                                lambda s: (state,) + batch(s)))
+    return out
+
+
 def _entry_train_step_sdtw3() -> list[CheckResult]:
     from milnce_tpu.config import LossConfig
     from milnce_tpu.train.step import make_train_step
@@ -309,6 +326,7 @@ def _entry_param_treedef() -> list[CheckResult]:
 
 ENTRY_POINTS = {
     "train_step_milnce": _entry_train_step_milnce,
+    "train_step_milnce_guarded": _entry_train_step_milnce_guarded,
     "train_step_sdtw3": _entry_train_step_sdtw3,
     "grad_cache_step_milnce": _entry_grad_cache_step,
     "retrieval_embed": _entry_retrieval_embed,

@@ -44,6 +44,18 @@ class DataConfig:
     prefetch_depth: int = 2             # device prefetch buffer (batches)
     decode_lookahead: int = 2           # extra batches of decode futures kept
                                         # in flight across batch boundaries
+    sample_timeout: float = 120.0       # decode watchdog: per-sample timeout
+                                        # (s), doubling per retry; a wedged
+                                        # decode escalates to the black-frame
+                                        # fallback instead of stalling the
+                                        # pod's next collective.  0 disables.
+    sample_timeout_retries: int = 2     # fresh decode attempts per sample
+                                        # before the watchdog escalates
+    max_failure_rate: float = 0.5       # abort the run (DataHealthError) when
+                                        # the decode-failure fraction exceeds
+                                        # this — a mostly-corrupt dataset must
+                                        # not silently train on black frames.
+                                        # 1.0 disables.
     synthetic: bool = False             # hermetic in-memory source (no ffmpeg)
     synthetic_num_samples: int = 256
 
@@ -208,6 +220,29 @@ class TrainConfig:
     max_steps: Optional[int] = None     # stop (with a checkpoint) after N
                                         # optimizer steps — bounded smoke /
                                         # bench runs; None = run all epochs
+    finite_guard: bool = True           # fold a per-step all-finite gradient
+                                        # check into the jitted step: a
+                                        # non-finite update is SKIPPED (params
+                                        # kept, jnp.where select — no host
+                                        # sync, no new collectives) and
+                                        # counted; surfaced at display cadence
+    skip_rollback_after: int = 25       # circuit breaker: after K CONSECUTIVE
+                                        # skipped updates, restore the last
+                                        # rotation checkpoint and resume past
+                                        # the poisoned data window instead of
+                                        # halting.  Checked at display cadence
+                                        # (the existing sync point), so keep
+                                        # K <= n_display.  0 disables.
+    faults: str = ""                    # fault-injection spec (chaos tests /
+                                        # drills), e.g. 'decode.raise@1,2;
+                                        # grad.nonfinite@3' — grammar and site
+                                        # catalogue in resilience/faults.py;
+                                        # also armable via MILNCE_FAULTS env
+    checkpoint_save_retries: int = 2    # transient-I/O retries (exponential
+                                        # backoff) before a checkpoint save
+                                        # gives up — a SIGTERM save must not
+                                        # race one flaky write for the whole
+                                        # partial epoch
     grad_accum: int = 1                 # microbatches per optimizer step
                                         # (two-pass embedding-cache MIL-NCE:
                                         # FULL global-batch negatives at 1/M

@@ -35,13 +35,20 @@ import numpy as np
 from milnce_tpu.config import DataConfig, ModelConfig
 from milnce_tpu.data.captions import CaptionTrack, sample_caption
 from milnce_tpu.data.tokenizer import Tokenizer, synthetic_vocab
-from milnce_tpu.data.video import (ClipDecoder, build_decoder, eval_windows,
-                                   sample_clip)
+from milnce_tpu.data.video import (ClipDecoder, black_sample, build_decoder,
+                                   eval_windows, sample_clip)
 
 
 def read_csv(path: str) -> list[dict]:
     with open(path, newline="") as f:
         return list(csv_mod.DictReader(f))
+
+
+class DataHealthError(RuntimeError):
+    """The decode-failure fraction exceeded ``data.max_failure_rate``:
+    the dataset (or its storage) is broken enough that continuing would
+    mean silently training on black-frame fallbacks.  Deliberately NOT
+    caught by the per-sample resampling — it must kill the run."""
 
 
 def build_tokenizer(model_cfg: ModelConfig, max_words: int) -> Tokenizer:
@@ -67,11 +74,13 @@ class HowTo100MSource:
     CAPTION_CACHE_SIZE = 4096   # bounded: 1.2M videos/epoch would otherwise
                                 # accumulate every parsed caption JSON in RAM
     MAX_RETRIES = 3             # resample attempts before black-frame fallback
-    LOGGED_FAILURES = 5         # stderr-log at most this many failure details
+    LOGGED_FAILURES = 5         # log at most this many failure details
+    FAILURE_RATE_MIN_ATTEMPTS = 20   # don't judge max_failure_rate on noise
 
     def __init__(self, cfg: DataConfig, model_cfg: ModelConfig,
                  decoder: Optional[ClipDecoder] = None,
-                 tokenizer: Optional[Tokenizer] = None):
+                 tokenizer: Optional[Tokenizer] = None,
+                 log_fn=None):
         self.cfg = cfg
         self.rows = read_csv(cfg.train_csv)
         assert self.rows and "video_path" in self.rows[0], cfg.train_csv
@@ -84,7 +93,14 @@ class HowTo100MSource:
         self._caption_cache: "OrderedDict[str, CaptionTrack]" = OrderedDict()
         self._cache_lock = threading.Lock()
         self.decode_failures = 0
+        self.decode_attempts = 0
         self._stats_lock = threading.Lock()
+        # failure details route through the run's logger when the loop
+        # provides it (satellite: no raw stderr prints from the source);
+        # standalone uses keep the stderr default
+        import sys
+
+        self._log = log_fn or (lambda m: print(m, file=sys.stderr))
 
     def __len__(self) -> int:
         return len(self.rows)
@@ -122,25 +138,47 @@ class HowTo100MSource:
             self.decode_failures += 1
             count = self.decode_failures
         if count <= self.LOGGED_FAILURES:
-            import sys
-            print(f"[data] sample {idx} failed "
-                  f"({type(exc).__name__}: {exc}); resampling "
-                  f"(total failures: {count})", file=sys.stderr)
+            self._log(f"[data] sample {idx} failed "
+                      f"({type(exc).__name__}: {exc}); resampling "
+                      f"(total failures: {count})")
+
+    def _check_health(self, exc: Exception) -> None:
+        """Abort the run when decode failures stop being the long tail
+        and become the dataset: without this, a 90%-corrupt manifest
+        "trains" on black frames with a green loss curve."""
+        limit = getattr(self.cfg, "max_failure_rate", 1.0)
+        if limit >= 1.0:
+            return
+        with self._stats_lock:
+            attempts, failures = self.decode_attempts, self.decode_failures
+        if attempts < self.FAILURE_RATE_MIN_ATTEMPTS:
+            return
+        rate = failures / attempts
+        if rate > limit:
+            raise DataHealthError(
+                f"decode-failure rate {rate:.2f} ({failures}/{attempts} "
+                f"attempts) exceeds data.max_failure_rate={limit} — the "
+                "dataset/storage is broken, refusing to train on "
+                "black-frame fallbacks") from exc
+
+    def fallback_sample(self) -> dict:
+        """The black-frame batch-contract fallback (data/video.py
+        black_sample): the bounded-resample last resort below and the
+        loader's decode-watchdog escalation (data/pipeline.py)."""
+        return black_sample(self.cfg)
 
     def sample(self, idx: int, rng: np.random.RandomState) -> dict:
         for _ in range(self.MAX_RETRIES + 1):
             try:
+                with self._stats_lock:
+                    self.decode_attempts += 1
                 return self._sample_one(idx, rng)
             except Exception as exc:
                 self._record_failure(idx, exc)
+                self._check_health(exc)
                 idx = int(rng.randint(len(self.rows)))
-        # Last resort (MAX_RETRIES+1 distinct bad draws): black frames +
-        # empty caption bag — a valid, if useless, sample; the step runs.
-        c = self.cfg
-        return {"video": np.zeros((c.num_frames, c.video_size, c.video_size,
-                                   3), np.uint8),
-                "text": np.zeros((c.num_candidates, c.max_words), np.int32),
-                "start": np.float32(0.0)}
+        # Last resort (MAX_RETRIES+1 distinct bad draws)
+        return self.fallback_sample()
 
 
 class YouCookSource:

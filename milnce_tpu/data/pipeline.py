@@ -20,6 +20,7 @@ Replaces torch ``DataLoader + DistributedSampler`` (main_distributed.py:
 from __future__ import annotations
 
 import concurrent.futures as cf
+import sys
 from typing import Iterator, Optional
 
 import jax
@@ -43,7 +44,10 @@ class ShardedLoader:
                  num_threads: int = 8, shuffle: bool = True,
                  process_index: Optional[int] = None,
                  process_count: Optional[int] = None,
-                 lookahead_batches: int = 2):
+                 lookahead_batches: int = 2,
+                 sample_timeout: float = 0.0,
+                 timeout_retries: int = 2,
+                 log_fn=None):
         self.source = source
         self.global_batch = int(global_batch_size)
         self.seed = seed
@@ -54,6 +58,59 @@ class ShardedLoader:
         assert self.global_batch % self.pc == 0, (global_batch_size, self.pc)
         self.local_batch = self.global_batch // self.pc
         self.lookahead_batches = max(0, int(lookahead_batches))
+        # Decode watchdog: a wedged decode (hung ffmpeg pipe, stuck NFS
+        # read) would otherwise park THIS host on fut.result() forever
+        # and wedge the whole pod at its next collective.  0 disables.
+        self.sample_timeout = float(sample_timeout)
+        self.timeout_retries = max(0, int(timeout_retries))
+        self.decode_timeouts = 0         # host-side counter (display line)
+        self._log = log_fn or (lambda m: print(m, file=sys.stderr))
+        self._logged_timeouts = 0
+
+    LOGGED_TIMEOUTS = 5                  # log detail for at most this many
+
+    def _await_sample(self, fut, idx, pool, fetch):
+        """Watchdog around one decode future: the timeout doubles per
+        retry (exponential backoff — a slow-but-alive store gets more
+        headroom each attempt), each retry is a FRESH decode of the same
+        index, and exhaustion escalates to the source's black-frame
+        fallback so one wedged pipe can't stall the pod.  The hung worker
+        thread is left to finish in the background — Python can't kill
+        it, but the pool simply runs one thread short until it returns."""
+        if not self.sample_timeout:
+            return fut.result()
+        for attempt in range(self.timeout_retries + 1):
+            try:
+                return fut.result(timeout=self.sample_timeout * (2 ** attempt))
+            except cf.TimeoutError:     # builtin TimeoutError on 3.11+
+                # Cancel before resubmitting: a future still QUEUED would
+                # otherwise run ANYWAY alongside its replacement —
+                # duplicate decode work arriving exactly when the pool is
+                # backlogged (positive feedback).  cancel() succeeding
+                # also means the sample never STARTED — that is queue
+                # backlog, not a wedged decode, so it doesn't count
+                # toward the wedge telemetry.
+                wedged = not fut.cancel()
+                if wedged:
+                    self.decode_timeouts += 1
+                    if self._logged_timeouts < self.LOGGED_TIMEOUTS:
+                        self._logged_timeouts += 1
+                        self._log(
+                            f"[data] decode watchdog: sample {int(idx)} "
+                            f"timed out after "
+                            f"{self.sample_timeout * (2 ** attempt):.1f}s "
+                            f"(attempt {attempt + 1}/"
+                            f"{self.timeout_retries + 1}; total timeouts: "
+                            f"{self.decode_timeouts})")
+                if attempt < self.timeout_retries:
+                    fut = pool.submit(fetch, idx)
+        fallback = getattr(self.source, "fallback_sample", None)
+        if fallback is not None:
+            return fallback()
+        raise TimeoutError(
+            f"decode of sample {int(idx)} exceeded the watchdog timeout "
+            f"{self.timeout_retries + 1}x and the source has no "
+            "fallback_sample()")
 
     def steps_per_epoch(self) -> int:
         # Tail always dropped: a short global batch cannot shard evenly
@@ -96,15 +153,23 @@ class ShardedLoader:
             submitted = 0
             for start in range(0, len(flat), self.local_batch):
                 while submitted < len(flat) and submitted < start + window:
-                    futs.append(pool.submit(fetch, flat[submitted]))
+                    idx = flat[submitted]
+                    futs.append((pool.submit(fetch, idx), idx))
                     submitted += 1
-                samples = [futs.popleft().result()
-                           for _ in range(self.local_batch)]
+                samples = []
+                for _ in range(self.local_batch):
+                    fut, idx = futs.popleft()
+                    samples.append(self._await_sample(fut, idx, pool, fetch))
                 yield {k: np.stack([s[k] for s in samples]) for k in samples[0]}
         finally:
             # generator may be closed mid-epoch (max_steps / preemption):
-            # drop queued decodes instead of draining them
+            # drop queued decodes instead of draining them, and reap the
+            # already-spawned ffmpeg children — cancel_futures only stops
+            # work that hasn't started (data/video.py inflight registry)
             pool.shutdown(wait=False, cancel_futures=True)
+            from milnce_tpu.data.video import kill_inflight_decoders
+
+            kill_inflight_decoders()
 
 
 def shard_placer(mesh: Mesh, axis: str = "data"):
@@ -118,7 +183,19 @@ def shard_placer(mesh: Mesh, axis: str = "data"):
     sharding = NamedSharding(mesh, P(axis))
     if jax.process_count() == 1:
         return lambda x: jax.device_put(x, sharding)
-    return lambda x: jax.make_array_from_process_local_data(sharding, x)
+
+    def place(x):
+        # THE deliberate pipeline H2D of the multi-process path (the
+        # exact counterpart of the explicit device_put above), but
+        # make_array_from_process_local_data lowers through
+        # batched_device_put, which the steady-state
+        # transfer_guard("disallow") classifies as implicit — found by
+        # the 2-process production-loop chaos run wedging at its first
+        # prefetch.  Scope the escape to this one call.
+        with jax.transfer_guard("allow"):
+            return jax.make_array_from_process_local_data(sharding, x)
+
+    return place
 
 
 def device_prefetch(iterator: Iterator[dict], mesh: Mesh,

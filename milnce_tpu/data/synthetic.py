@@ -26,6 +26,14 @@ class SyntheticVideoTextSource:
     def __len__(self) -> int:
         return self.num_samples
 
+    def fallback_sample(self) -> dict:
+        """The black-frame batch-contract fallback (data/video.py
+        black_sample) — the loader's decode-watchdog escalation target,
+        so chaos tests can drive the hang path hermetically."""
+        from milnce_tpu.data.video import black_sample
+
+        return black_sample(self.cfg)
+
     def sample(self, idx: int, rng: np.random.RandomState) -> dict:
         c = self.cfg
         base = np.random.RandomState(idx % 1000)

@@ -26,10 +26,56 @@ from __future__ import annotations
 
 import shutil
 import subprocess
+import threading
 from dataclasses import dataclass
 from typing import Protocol
 
 import numpy as np
+
+from milnce_tpu.resilience import faults
+
+
+# In-flight decoder children, registered for kill-on-close: a mid-epoch
+# stop (max_steps / preemption) cancels QUEUED decode futures, but the
+# ffmpeg children already spawned would keep decoding to completion —
+# orphaned CPU burn racing the preemption grace window.  Every
+# subprocess-backed decode registers its Popen here for the duration of
+# the pipe read; ShardedLoader's generator close calls
+# :func:`kill_inflight_decoders`.
+_INFLIGHT: set = set()
+_INFLIGHT_LOCK = threading.Lock()
+
+
+def _register_inflight(proc) -> None:
+    with _INFLIGHT_LOCK:
+        _INFLIGHT.add(proc)
+
+
+def _unregister_inflight(proc) -> None:
+    with _INFLIGHT_LOCK:
+        _INFLIGHT.discard(proc)
+
+
+def kill_inflight_decoders(grace: float = 0.2) -> int:
+    """SIGTERM (then SIGKILL after ``grace``) every registered in-flight
+    decode child; returns how many were signalled.  The owning decode()
+    call then fails its pipe read — callers are already past caring (the
+    epoch generator is closing).  Process-wide by design: at close time
+    the training epoch owns every live training decode."""
+    with _INFLIGHT_LOCK:
+        procs = list(_INFLIGHT)
+    for proc in procs:
+        if proc.poll() is None:
+            proc.terminate()
+    killed = 0
+    for proc in procs:
+        if proc.poll() is None:
+            try:
+                proc.wait(timeout=grace)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        killed += 1
+    return killed
 
 
 class ClipDecoder(Protocol):
@@ -79,8 +125,18 @@ class FFmpegDecoder:
                 "synthetic data source (data.synthetic=True)")
         cmd = self.command(path, start_seek, num_sec, fps, size, aw, ah,
                            crop_only, hflip)
-        out = subprocess.run(cmd, stdout=subprocess.PIPE,
-                             stderr=subprocess.DEVNULL, check=True).stdout
+        # Popen (not subprocess.run) so the child is registered while its
+        # pipe is being pumped: kill_inflight_decoders() can reap it on a
+        # mid-epoch generator close instead of orphaning a full decode.
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL)
+        _register_inflight(proc)
+        try:
+            out, _ = proc.communicate()
+        finally:
+            _unregister_inflight(proc)
+        if proc.returncode != 0:        # parity with subprocess.run(check=True)
+            raise subprocess.CalledProcessError(proc.returncode, cmd)
         n = len(out) // (size * size * 3)
         return np.frombuffer(out[: n * size * size * 3],
                              np.uint8).reshape(n, size, size, 3)
@@ -278,8 +334,13 @@ def build_decoder(backend: str = "auto", use_native_reader: bool = False,
                   workers: int = 8) -> ClipDecoder:
     """Production decoder factory.  ``auto`` prefers the ffmpeg binary
     (reference's tool, and the native ReaderPool needs an argv to popen)
-    and falls back to in-process cv2 when no binary is installed."""
+    and falls back to in-process cv2 when no binary is installed.
+    ``fake`` is the hermetic backend (deterministic pseudo-frames, zero
+    I/O) — dry runs and the chaos tests drive the REAL source/loader
+    stack through it without touching a codec."""
     requested = backend
+    if backend == "fake":
+        return FakeDecoder()
     if backend == "auto":
         # an explicit native-reader request implies the ffmpeg pipe-pump
         # path: honor it rather than silently resolving to cv2 — but fail
@@ -318,7 +379,7 @@ def build_decoder(backend: str = "auto", use_native_reader: bool = False,
                 "cv2 decodes in-process — flag ignored", stacklevel=2)
         return dec
     raise ValueError(f"unknown decoder backend {backend!r} "
-                     "(expected auto|ffmpeg|cv2)")
+                     "(expected auto|ffmpeg|cv2|fake)")
 
 
 @dataclass
@@ -344,6 +405,18 @@ class FakeDecoder:
         return self.fixed_duration
 
 
+def black_sample(cfg) -> dict:
+    """Black frames + empty caption bag + zero start: a valid, if
+    useless, sample with the exact training batch contract.  The ONE
+    definition of that fallback shape — the sources' bounded-resample
+    last resort and the loader watchdog's escalation target
+    (data/pipeline.py) both delegate here, so the contract can't fork."""
+    return {"video": np.zeros((cfg.num_frames, cfg.video_size,
+                               cfg.video_size, 3), np.uint8),
+            "text": np.zeros((cfg.num_candidates, cfg.max_words), np.int32),
+            "start": np.float32(0.0)}
+
+
 def pad_or_trim(frames: np.ndarray, num_frames: int) -> np.ndarray:
     """Zero-pad the tail / truncate to exactly ``num_frames``
     (video_loader.py:92-95)."""
@@ -361,6 +434,11 @@ def sample_clip(decoder: ClipDecoder, path: str, start: float, end: float,
     """Random training clip draw within [start, end]
     (video_loader.py:58-95): random seek, random or center fractional
     crop offset, coin-flip hflip."""
+    # Fault sites at the decode chokepoint (backend-agnostic, inside the
+    # source's resample/retry scope): chaos tests drive the bounded
+    # resample and the loader watchdog through here — zero-cost disarmed.
+    faults.maybe_raise("decode.raise")
+    faults.maybe_hang("decode.hang")
     num_sec = num_frames / float(fps)
     hi = int(max(start, end - num_sec))
     start_seek = rng.randint(int(start), hi + 1)

@@ -54,5 +54,5 @@ class ReaderPool:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # graftlint: disable=GL007(finalizer during interpreter teardown: raising here only produces unraisable-exception noise; close() is best-effort by contract)
             pass

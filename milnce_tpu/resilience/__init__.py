@@ -1,0 +1,11 @@
+"""Fault-tolerant training runtime: deterministic fault injection plus
+the recovery machinery it exercises (decode watchdog, finite-update
+guard, checkpoint save retry).  See ROBUSTNESS.md for the failure
+matrix: fault -> detection site -> response -> test."""
+
+from milnce_tpu.resilience.faults import (FaultRegistry, InjectedFault,
+                                          arm, armed, device_schedule,
+                                          disarm, maybe_hang, maybe_raise)
+
+__all__ = ["FaultRegistry", "InjectedFault", "arm", "armed",
+           "device_schedule", "disarm", "maybe_hang", "maybe_raise"]

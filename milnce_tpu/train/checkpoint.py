@@ -14,6 +14,7 @@ from typing import Optional, Tuple
 
 import orbax.checkpoint as ocp
 
+from milnce_tpu.resilience import faults
 from milnce_tpu.train.state import TrainState
 
 
@@ -21,11 +22,21 @@ _STALE_PREFIX = "stale-epoch-"   # non-numeric => invisible to Orbax's step scan
 
 
 class CheckpointManager:
-    def __init__(self, directory: str, keep: int = 10, create: bool = True):
+    def __init__(self, directory: str, keep: int = 10, create: bool = True,
+                 save_retries: int = 2, retry_backoff: float = 0.25):
         """``create=False`` opens read-only — export/inspection consumers
-        must not mkdir a mistyped run directory as a side effect."""
+        must not mkdir a mistyped run directory as a side effect.
+
+        ``save_retries``/``retry_backoff``: transient-I/O retry policy for
+        saves — a preemption (SIGTERM) save races the grace window against
+        storage that at pod scale IS flaky, and losing the whole partial
+        epoch to one transient write error is the wrong trade.  OSError
+        during the save submit is retried with exponential backoff
+        (``retry_backoff * 2**attempt`` seconds) before re-raising."""
         directory = os.path.abspath(directory)
         self._directory = directory
+        self.save_retries = max(0, int(save_retries))
+        self.retry_backoff = float(retry_backoff)
         if create:
             self._recover_interrupted_replacements()
         options = ocp.CheckpointManagerOptions(
@@ -62,7 +73,14 @@ class CheckpointManager:
         try:
             entries = os.listdir(self._directory)
         except FileNotFoundError:
-            return
+            # No run dir yet — nothing to recover, but DO fall through to
+            # the sync below: an early return here raced multi-process
+            # opens (a fast process skips the sync; a slower one sees the
+            # directory the fast one's Orbax init just created, lists it,
+            # and syncs — pairing its collective with some LATER one and
+            # wedging the cluster at startup).  Every process must run
+            # the same collective sequence unconditionally.
+            entries = []
         if jax.process_index() == 0:
             for name in entries:
                 m = re.fullmatch(_STALE_PREFIX + r"(\d+)", name)
@@ -75,6 +93,42 @@ class CheckpointManager:
                 else:
                     os.rename(backup, step_dir)
         self._sync("recover")
+
+    def _save_with_retry(self, epoch: int, state: TrainState,
+                         force: bool) -> None:
+        """One Orbax save submit, retried on transient I/O failure.  Only
+        OSError is retried — Orbax protocol errors (StepAlreadyExists,
+        bad args) are bugs and re-raise immediately.  The
+        ``ckpt.save_ioerror`` fault site sits inside the retried region so
+        chaos tests drive exactly this path (tests/test_resilience.py)."""
+        import logging
+        import time
+
+        import jax
+
+        # Single-process only: a per-host retry on a MULTI-host cluster
+        # would desync the collective sequence (the failing host re-enters
+        # Orbax's cross-process coordination while its peers have moved
+        # on) — the same every-process-runs-the-same-collectives rule as
+        # _recover_interrupted_replacements.  Making the retry verdict
+        # cluster-uniform needs an agreement collective this layer
+        # doesn't own; until then multi-process re-raises immediately.
+        retries = self.save_retries if jax.process_count() == 1 else 0
+        for attempt in range(retries + 1):
+            try:
+                faults.maybe_raise("ckpt.save_ioerror", OSError)
+                self._mgr.save(epoch, args=ocp.args.StandardSave(state),
+                               force=force)
+                return
+            except OSError as exc:
+                if attempt >= retries:
+                    raise
+                delay = self.retry_backoff * (2 ** attempt)
+                logging.getLogger(__name__).warning(
+                    "checkpoint save of epoch %d failed (%s: %s); retrying "
+                    "in %.2fs (attempt %d/%d)", epoch, type(exc).__name__,
+                    exc, delay, attempt + 1, retries)
+                time.sleep(delay)
 
     def save(self, epoch: int, state: TrainState,
              force: bool = False) -> None:
@@ -112,15 +166,14 @@ class CheckpointManager:
                 self._mgr.reload()          # drop the cached step listing
             else:                           # step tracked but dir absent
                 self._mgr.delete(epoch)     # (custom storage) — old path
-            self._mgr.save(epoch, args=ocp.args.StandardSave(state),
-                           force=force)
+            self._save_with_retry(epoch, state, force)
             self._mgr.wait_until_finished()  # commit before dropping backup
             if have_backup:
                 if jax.process_index() == 0 and os.path.isdir(backup):
                     shutil.rmtree(backup)
                 self._sync("committed")
             return
-        self._mgr.save(epoch, args=ocp.args.StandardSave(state), force=force)
+        self._save_with_retry(epoch, state, force)
 
     def latest_epoch(self) -> Optional[int]:
         return self._mgr.latest_step()

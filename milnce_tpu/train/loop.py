@@ -19,6 +19,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from milnce_tpu.config import Config
@@ -28,6 +29,7 @@ from milnce_tpu.data.synthetic import SyntheticVideoTextSource
 from milnce_tpu.models.build import build_model
 from milnce_tpu.parallel.mesh import (build_mesh, initialize_distributed,
                                       replicate_to_mesh)
+from milnce_tpu.resilience import faults
 from milnce_tpu.train.checkpoint import CheckpointManager
 from milnce_tpu.train.schedule import build_host_schedule, build_schedule
 from milnce_tpu.train.state import TrainState, build_optimizer, create_train_state
@@ -36,12 +38,66 @@ from milnce_tpu.utils.logging import RunLogger
 from milnce_tpu.utils.profiling import StepTimer, maybe_trace
 
 
-def build_source(cfg: Config):
+def build_source(cfg: Config, log_fn=None):
     if cfg.data.synthetic:
         return SyntheticVideoTextSource(cfg.data, vocab_size=cfg.model.vocab_size)
     from milnce_tpu.data.datasets import HowTo100MSource
 
-    return HowTo100MSource(cfg.data, cfg.model)
+    return HowTo100MSource(cfg.data, cfg.model, log_fn=log_fn)
+
+
+def resume_batch_offset(restored_step: int, steps_per_epoch: int) -> int:
+    """Mid-epoch resume position: how many global batches of the current
+    epoch the restored step counter has already consumed (an end-of-epoch
+    save lands on the boundary -> 0).  Only valid while steps_per_epoch
+    matches the run being resumed."""
+    return int(restored_step) % steps_per_epoch
+
+
+def stop_save_label(epoch: int, opt_step: int,
+                    steps_per_epoch: int) -> tuple:
+    """(checkpoint label, force) for a mid-epoch stop at ``opt_step``.
+
+    A stop landing ON the epoch's last batch labels epoch+1 (a
+    current-epoch label with offset 0 would retrain the whole epoch on
+    resume); any other stop labels the CURRENT epoch and must FORCE the
+    save — the previous epoch's boundary save holds the same label and
+    Orbax would otherwise silently skip it, dropping the partial epoch."""
+    done = opt_step % steps_per_epoch == 0
+    return (epoch + 1 if done else epoch), (not done)
+
+
+# Finite-guard window accumulators: pure device-side jnp (jitted), so the
+# per-step bookkeeping adds one tiny async dispatch and ZERO host syncs.
+# Skipped (non-finite) steps are excluded from the windowed loss mean —
+# their loss is the NaN the guard just refused to apply — and drive a
+# consecutive-skip counter for the loop's circuit breaker.
+def _guard_restart(loss, skipped, consec, total):
+    keep = skipped == 0
+    running = jnp.where(keep, loss, jnp.zeros_like(loss))
+    valid = keep.astype(jnp.int32)
+    consec = jnp.where(keep, jnp.zeros_like(consec), consec + 1)
+    return running, valid, consec, total + skipped
+
+
+def _guard_acc(running, valid, consec, total, loss, skipped):
+    keep = skipped == 0
+    return (jnp.where(keep, running + loss, running),
+            valid + keep.astype(valid.dtype),
+            jnp.where(keep, jnp.zeros_like(consec), consec + 1),
+            total + skipped)
+
+
+_guard_restart_j = jax.jit(_guard_restart)
+_guard_acc_j = jax.jit(_guard_acc)
+
+
+def _fetch_guard_window(running, valid, consec, total):
+    """Display-cadence fetch of the guarded window: ONE host transfer for
+    the mean-over-valid-steps loss plus both skip counters."""
+    r, v, c, t = jax.device_get((running, valid, consec, total))  # graftlint: disable=GL001(display-cadence fetch — the one deliberate sync point of the guarded window)
+    mean = float(r) / int(v) if int(v) else float("nan")  # graftlint: disable=GL001(host numpy values already fetched above, not device values)
+    return mean, int(c), int(t)  # graftlint: disable=GL001(host numpy values already fetched above, not device values)
 
 
 @dataclass
@@ -49,6 +105,9 @@ class TrainResult:
     state: TrainState
     steps: int
     last_loss: float
+    skipped_steps: int = 0      # finite-guard: updates skipped on
+                                # non-finite gradients (0 when disabled)
+    rollbacks: int = 0          # circuit-breaker checkpoint restores
 
 
 def _in_training_eval(cfg: Config, model, state: TrainState, mesh,
@@ -94,6 +153,10 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
             raise ValueError(
                 f"unknown train.eval_task {cfg.train.eval_task!r}; "
                 f"expected one of {'|'.join(EVAL_TASKS)}")
+    if cfg.train.faults:
+        # deterministic fault injection (chaos tests / failure drills):
+        # armed before any decode or step build so every site sees it
+        faults.arm(cfg.train.faults)
     initialize_distributed(cfg.parallel)
     mesh = build_mesh(cfg.parallel)
     axis = cfg.parallel.data_axis
@@ -103,10 +166,13 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
     logger.log(f"mesh: {mesh.shape} | devices: {len(jax.devices())} "
                f"| global batch: {cfg.train.batch_size}")
 
-    source = build_source(cfg)
+    source = build_source(cfg, log_fn=logger.log)
     loader = ShardedLoader(source, cfg.train.batch_size, seed=cfg.train.seed,
                            num_threads=cfg.data.num_reader_threads,
-                           lookahead_batches=cfg.data.decode_lookahead)
+                           lookahead_batches=cfg.data.decode_lookahead,
+                           sample_timeout=cfg.data.sample_timeout,
+                           timeout_retries=cfg.data.sample_timeout_retries,
+                           log_fn=logger.log)
     steps_per_epoch = loader.steps_per_epoch()
     assert steps_per_epoch > 0, "dataset smaller than one global batch"
 
@@ -130,7 +196,8 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
 
     ckpt_dir = os.path.join(cfg.train.checkpoint_root,
                             cfg.train.checkpoint_dir or "run")
-    manager = CheckpointManager(ckpt_dir, keep=cfg.train.checkpoint_keep)
+    manager = CheckpointManager(ckpt_dir, keep=cfg.train.checkpoint_keep,
+                                save_retries=cfg.train.checkpoint_save_retries)
     start_epoch = 0
     resume_skip = 0
     if cfg.train.resume:
@@ -141,7 +208,7 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
         # no sample is trained twice (an end-of-epoch save lands on a
         # steps_per_epoch boundary -> skip 0).  Only valid while
         # steps_per_epoch matches the run being resumed.
-        resume_skip = int(state.step) % steps_per_epoch
+        resume_skip = resume_batch_offset(int(state.step), steps_per_epoch)
         logger.log(f"resumed from epoch {start_epoch}"
                    + (f" at batch {resume_skip}" if resume_skip else ""))
 
@@ -154,15 +221,17 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
     # composes with the batch-sharded step inputs.
     state = replicate_to_mesh(state, mesh)
 
+    guard_on = cfg.train.finite_guard
     if cfg.train.grad_accum > 1:
         from milnce_tpu.train.step import make_grad_cache_step
 
         step_fn = make_grad_cache_step(model, optimizer, mesh,
                                        cfg.train.grad_accum, data_axis=axis,
-                                       loss_cfg=cfg.loss)
+                                       loss_cfg=cfg.loss,
+                                       finite_guard=guard_on)
     else:
         step_fn = make_train_step(model, optimizer, mesh, data_axis=axis,
-                                  loss_cfg=cfg.loss)
+                                  loss_cfg=cfg.loss, finite_guard=guard_on)
 
     # Preemption-safe shutdown: TPU-VM maintenance events deliver SIGTERM;
     # save a checkpoint and exit cleanly instead of losing the epoch (the
@@ -208,6 +277,12 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
     total_steps = 0
     last_loss_dev = None
     running_dev = None
+    valid_dev = None            # finite guard: non-skipped steps in window
+    consec_dev = None           # finite guard: consecutive skipped updates
+    skips_total_dev = None      # finite guard: run-total skipped updates
+    rollbacks = 0
+    last_rollback = None        # (total_steps, total_skips) at the last
+                                # breaker trip — bounds the rollback loop
     window = 0
     timer = StepTimer(clips_per_step=cfg.train.batch_size)
     # Wall clock feeds the human-facing elapsed display only; bench numbers
@@ -239,6 +314,13 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
         # design; see the n_display branch)
         return (float(jax.device_get(dev_val))  # graftlint: disable=GL001(display/exit-cadence fetch of the windowed loss — the deliberate sync point, not a per-step one)
                 if dev_val is not None else float("nan"))
+
+    def exit_metrics():
+        # one transfer covers both the final loss and the skip counter
+        if skips_total_dev is None:
+            return fetch(last_loss_dev), 0
+        last, k = jax.device_get((last_loss_dev, skips_total_dev))  # graftlint: disable=GL001(exit-cadence fetch — one transfer for final loss + skip count)
+        return float(last), int(k)  # graftlint: disable=GL001(host numpy values already fetched above, not device values)
 
     def check_finite(mean_loss: float, step_label: int) -> None:
         """Divergence guard, evaluated only at display fetches (no extra
@@ -282,12 +364,42 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                                          depth=cfg.data.prefetch_depth):
                 video, text = flatten_text(batch)
                 start = batch.get("start", zero_start)
-                state, loss = step_fn(state, video, text, start)
+                if guard_on:
+                    state, loss, skipped = step_fn(state, video, text, start)
+                    skipped = skipped.addressable_data(0)
+                else:
+                    state, loss = step_fn(state, video, text, start)
+                # Accumulate on the PROCESS-LOCAL replica of the (P()-
+                # replicated) loss: a zero-copy shard view.  Eager/jit
+                # arithmetic on the multi-process global array itself is
+                # a cross-process XLA computation — unsupported on the
+                # CPU backend and pure waste on TPU (every process holds
+                # the full value; SPMD determinism keeps the per-process
+                # accumulators identical, so display/breaker verdicts
+                # stay cluster-uniform).
+                loss = loss.addressable_data(0)
                 total_steps += 1
                 window += 1
                 timer.tick()
-                # async device-side accumulation — no host sync here
-                running_dev = loss if running_dev is None else running_dev + loss
+                # async device-side accumulation — no host sync here (the
+                # guard trackers are jitted jnp updates on device scalars)
+                if guard_on:
+                    if consec_dev is None:
+                        consec_dev = skipped - skipped      # local-shard 0
+                    if skips_total_dev is None:
+                        skips_total_dev = skipped - skipped
+                    if running_dev is None:
+                        (running_dev, valid_dev, consec_dev,
+                         skips_total_dev) = _guard_restart_j(
+                            loss, skipped, consec_dev, skips_total_dev)
+                    else:
+                        (running_dev, valid_dev, consec_dev,
+                         skips_total_dev) = _guard_acc_j(
+                            running_dev, valid_dev, consec_dev,
+                            skips_total_dev, loss, skipped)
+                else:
+                    running_dev = (loss if running_dev is None
+                                   else running_dev + loss)
                 last_loss_dev = loss
                 if window % cfg.train.n_display == 0:
                   # LR + progress from the host step counter (seeded by
@@ -297,16 +409,80 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                   lr = host_schedule(opt_step)
                   progress = (opt_step % steps_per_epoch) / steps_per_epoch
                   with jax.transfer_guard("allow"):  # display-cadence fetch
-                    mean_loss = fetch(running_dev) / window
+                    consec = 0
+                    extra = ""
+                    if guard_on:
+                        mean_loss, consec, k_total = _fetch_guard_window(
+                            running_dev, valid_dev, consec_dev,
+                            skips_total_dev)
+                        extra += f", Skipped steps: {k_total}"
+                    else:
+                        mean_loss = fetch(running_dev) / window
+                    fails = getattr(source, "decode_failures", 0)
+                    extra += f", Decode failures: {fails}"
+                    if loader.decode_timeouts:
+                        extra += (f", Decode timeouts: "
+                                  f"{loader.decode_timeouts}")
                     logger.log(
                         f"Epoch {epoch + 1}, Elapsed Time: "
                         f"{time.time() - tick:.3f}, Epoch status: "
                         f"{progress:.4f}, Training loss: "
                         f"{mean_loss:.4f}, "
                         f"Learning rate: {lr:.6f}, Throughput: "
-                        f"{timer.clips_per_sec:.1f} clips/s")
-                    check_finite(mean_loss, opt_step)
+                        f"{timer.clips_per_sec:.1f} clips/s{extra}")
+                    # a guarded window with ZERO applied updates displays
+                    # nan by construction — that is the breaker's case to
+                    # handle, not the halt-on-nan divergence guard's
+                    if not (guard_on and np.isnan(mean_loss)):
+                        check_finite(mean_loss, opt_step)
+                    if (guard_on and cfg.train.skip_rollback_after
+                            and consec >= cfg.train.skip_rollback_after):
+                        # Circuit breaker: K consecutive non-finite
+                        # updates means the guard alone isn't enough (a
+                        # poisoned data window, diverged state).  Roll the
+                        # WEIGHTS back to the last rotation checkpoint but
+                        # keep the CURRENT step counter — it tracks
+                        # batches consumed, so the run resumes PAST the
+                        # poisoned window instead of replaying it (or
+                        # halting, as the pre-breaker NaN guard did).
+                        latest = manager.latest_epoch()
+                        if latest is None:
+                            raise FloatingPointError(
+                                f"{consec} consecutive non-finite updates "
+                                "and no rotation checkpoint to roll back "
+                                "to — halting")
+                        # Termination bound: a rollback is only worth
+                        # repeating if SOME update applied since the last
+                        # one.  Zero applied updates between trips means
+                        # the failure is persistent (LR bug, corrupted
+                        # hardware, every-step injection), and looping
+                        # rollback-skip-rollback would burn the pod
+                        # forever — halt like the pre-breaker NaN guard.
+                        if last_rollback is not None:
+                            applied = ((total_steps - last_rollback[0])
+                                       - (k_total - last_rollback[1]))
+                            if applied <= 0:
+                                raise FloatingPointError(
+                                    f"circuit breaker: {consec} consecutive "
+                                    "non-finite updates with ZERO applied "
+                                    "updates since the previous rollback — "
+                                    "the failure is persistent, halting "
+                                    "instead of rolling back in a loop")
+                        last_rollback = (total_steps, k_total)
+                        manager.wait()
+                        restored = manager.restore(latest, state)
+                        state = restored.replace(
+                            step=jnp.asarray(opt_step, jnp.int32))
+                        state = replicate_to_mesh(state, mesh)
+                        rollbacks += 1
+                        consec_dev = None       # fresh weights: reset streak
+                        logger.log(
+                            f"circuit breaker: {consec} consecutive "
+                            f"non-finite updates — restored rotation "
+                            f"checkpoint {latest}, resuming at step "
+                            f"{opt_step} past the poisoned data window")
                   running_dev = None
+                  valid_dev = None
                   window = 0
                   timer.reset()
                   tick = time.time()
@@ -329,25 +505,23 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                         logger.log("SIGTERM — checkpointing and exiting"
                                    + (" (cluster-coordinated)" if multi
                                       else ""))
-                    # mid-epoch stop: label the checkpoint with the CURRENT
-                    # epoch so resume continues it (the restored step
-                    # counter gives the batch offset).  A stop landing on
-                    # the epoch's LAST batch must label epoch+1 — a
-                    # current-epoch label with offset 0 would retrain the
-                    # whole epoch on resume.  force: the previous epoch's
-                    # boundary save holds the same label and Orbax would
-                    # otherwise silently skip this save, losing the
-                    # partial epoch (see CheckpointManager.save).
-                    done = (opt_step0 + total_steps) % steps_per_epoch == 0
-                    manager.save(epoch + 1 if done else epoch, state,
-                                 force=not done)
+                    # label/force semantics: stop_save_label (module top);
+                    # epoch-boundary edge cases pinned in
+                    # tests/test_resilience.py + test_train.py
+                    label, force = stop_save_label(
+                        epoch, opt_step0 + total_steps, steps_per_epoch)
+                    manager.save(label, state, force=force)
                     manager.wait()
-                    return TrainResult(state, total_steps,
-                                       fetch(last_loss_dev))
+                    last, skips = exit_metrics()
+                    return TrainResult(state, total_steps, last,
+                                       skips, rollbacks)
             with jax.transfer_guard("allow"):       # epoch-boundary save
                 manager.save(epoch + 1, state)
     finally:
         manager.wait()
+        if cfg.train.faults:
+            faults.disarm()     # a config-armed registry dies with the run
         if prev_handler is not None:
             signal.signal(signal.SIGTERM, prev_handler)
-    return TrainResult(state, total_steps, fetch(last_loss_dev))
+    last, skips = exit_metrics()
+    return TrainResult(state, total_steps, last, skips, rollbacks)

@@ -30,7 +30,46 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from milnce_tpu.losses.milnce import milnce_loss
 from milnce_tpu.parallel.compat import donation_argnums, shard_map
+from milnce_tpu.resilience import faults
 from milnce_tpu.train.state import TrainState
+
+
+def _apply_grad_poison(grads, step):
+    """Device-side ``grad.nonfinite`` fault site: when armed at BUILD
+    time, multiply the reduced gradients by NaN on scheduled optimizer
+    steps (``state.step + 1`` is the 1-based occurrence index — see
+    resilience/faults.py).  The schedule is baked into the trace as pure
+    jnp ops on ``state.step``: deterministic, no host sync, and adds
+    nothing at all when disarmed."""
+    spec = faults.device_schedule("grad.nonfinite")
+    if spec is None:
+        return grads
+    n = step + 1
+    if spec.mode == "all":
+        hit = jnp.bool_(True)
+    elif spec.mode == "every":
+        hit = (n % spec.every) == 0
+    else:
+        hit = jnp.any(n == jnp.asarray(spec.at, jnp.int32))
+    poison = jnp.where(hit, jnp.float32(jnp.nan), jnp.float32(1.0))
+    return jax.tree_util.tree_map(lambda g: g * poison.astype(g.dtype), grads)
+
+
+def _all_finite(tree):
+    """Scalar bool: every leaf of ``tree`` is all-finite.  Computed on
+    the already-reduced (replicated) gradients, so no collective is
+    needed and every shard reaches the same verdict."""
+    ok = jnp.bool_(True)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        ok = ok & jnp.all(jnp.isfinite(leaf))
+    return ok
+
+
+def _select_tree(ok, new, old):
+    """Leaf-wise ``jnp.where(ok, new, old)`` — the skip-update select of
+    the finite guard (params / opt_state / batch_stats keep their
+    pre-step values on a non-finite gradient)."""
+    return jax.tree_util.tree_map(lambda n, o: jnp.where(ok, n, o), new, old)
 
 
 def _sequence_loss(loss_cfg, v_seq, t_seq, start, data_axis):
@@ -84,7 +123,8 @@ def _check_loss_name(loss_cfg) -> str:
 
 def make_grad_cache_step(model, optimizer, mesh: Mesh,
                          micro_batches: int, data_axis: str = "data",
-                         donate: bool = True, loss_cfg=None):
+                         donate: bool = True, loss_cfg=None,
+                         finite_guard: bool = False):
     """Two-pass embedding-cache train step (GradCache-style) for every
     batch-contrastive loss: MIL-NCE and the DTW family.
 
@@ -182,6 +222,7 @@ def make_grad_cache_step(model, optimizer, mesh: Mesh,
 
         reduce = lax.psum if loss_name == "milnce" else lax.pmean
         grads = reduce(grads, data_axis)
+        grads = _apply_grad_poison(grads, state.step)
         # merge BN stats over microbatches then shards: a microbatch is a
         # virtual shard, so mean-of-means matches the M*N-chip run
         new_stats = jax.tree_util.tree_map(
@@ -189,26 +230,44 @@ def make_grad_cache_step(model, optimizer, mesh: Mesh,
         updates, new_opt = optimizer.update(grads, state.opt_state,
                                             state.params)
         new_params = optax.apply_updates(state.params, updates)
+        if finite_guard:    # same skip-update semantics as make_train_step
+            ok = _all_finite(grads)
+            new_params = _select_tree(ok, new_params, state.params)
+            new_opt = _select_tree(ok, new_opt, state.opt_state)
+            new_stats = _select_tree(ok, new_stats, state.batch_stats)
+            return TrainState(step=state.step + 1, params=new_params,
+                              batch_stats=new_stats,
+                              opt_state=new_opt), loss, (~ok).astype(jnp.int32)
         return TrainState(step=state.step + 1, params=new_params,
                           batch_stats=new_stats, opt_state=new_opt), loss
 
+    out_specs = (P(), P(), P()) if finite_guard else (P(), P())
     sharded = shard_map(
         local_step, mesh=mesh,
         in_specs=(P(), P(data_axis), P(data_axis), P(data_axis)),
-        out_specs=(P(), P()),
+        out_specs=out_specs,
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=donation_argnums(0) if donate else ())
 
 
 def make_train_step(model, optimizer, mesh: Mesh, data_axis: str = "data",
-                    donate: bool = True, loss_cfg=None, inner_steps: int = 1):
+                    donate: bool = True, loss_cfg=None, inner_steps: int = 1,
+                    finite_guard: bool = False):
     """Build the jitted train step.
 
     Returns ``step_fn(state, video_u8, text_ids, start) -> (state, loss)``:
     ``video_u8`` (B, T, H, W, 3) uint8, ``text_ids`` (B*K, W) int32,
     ``start`` (B,) float32 clip start-times (used by the CIDM loss; pass
     zeros otherwise) — all sharded on dim 0; ``state`` replicated.
+
+    ``finite_guard=True`` folds a per-step all-finite gradient check into
+    the jitted program and returns ``(state, loss, skipped)`` instead: a
+    non-finite gradient keeps params/opt_state/batch_stats at their
+    pre-step values via ``jnp.where`` (``skipped`` int32 1) — no host
+    sync, no new collectives (pinned by the trace invariants).  The step
+    counter still advances: it tracks batches CONSUMED, which the
+    mid-epoch resume math relies on.
 
     Loss selection (LossConfig.name): 'milnce' scores pooled embeddings
     with per-shard partial sums psum'd inside the loss, so gradients are
@@ -251,11 +310,20 @@ def make_train_step(model, optimizer, mesh: Mesh, data_axis: str = "data",
             loss_fn, has_aux=True)(state.params)
         reduce = lax.psum if loss_name == "milnce" else lax.pmean
         grads = reduce(grads, data_axis)
+        grads = _apply_grad_poison(grads, state.step)
         new_stats = jax.tree_util.tree_map(
             lambda x: lax.pmean(x, data_axis), new_stats)
         updates, new_opt = optimizer.update(grads, state.opt_state,
                                             state.params)
         new_params = optax.apply_updates(state.params, updates)
+        if finite_guard:
+            ok = _all_finite(grads)
+            new_params = _select_tree(ok, new_params, state.params)
+            new_opt = _select_tree(ok, new_opt, state.opt_state)
+            new_stats = _select_tree(ok, new_stats, state.batch_stats)
+            new_state = TrainState(step=state.step + 1, params=new_params,
+                                   batch_stats=new_stats, opt_state=new_opt)
+            return new_state, loss, (~ok).astype(jnp.int32)
         new_state = TrainState(step=state.step + 1, params=new_params,
                                batch_stats=new_stats, opt_state=new_opt)
         return new_state, loss
@@ -263,19 +331,23 @@ def make_train_step(model, optimizer, mesh: Mesh, data_axis: str = "data",
     if inner_steps > 1:
         def local_loop(state, video_u8, text_ids, start):
             def body(st, _):
-                return local_step(st, video_u8, text_ids, start)
+                out = local_step(st, video_u8, text_ids, start)
+                return out[0], out[1:]
 
-            state, losses = lax.scan(body, state, None, length=inner_steps)
-            return state, losses[-1]
+            state, outs = lax.scan(body, state, None, length=inner_steps)
+            if finite_guard:
+                return state, outs[0][-1], outs[1].sum()
+            return state, outs[0][-1]
 
         local_fn = local_loop
     else:
         local_fn = local_step
 
+    out_specs = (P(), P(), P()) if finite_guard else (P(), P())
     sharded = shard_map(
         local_fn, mesh=mesh,
         in_specs=(P(), P(data_axis), P(data_axis), P(data_axis)),
-        out_specs=(P(), P()),
+        out_specs=out_specs,
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=donation_argnums(0) if donate else ())

@@ -86,6 +86,30 @@ def audited_timing(f, x):
     return time.perf_counter() - t0
 
 
+# ---- GL007 swallowed-broad-except --------------------------------------
+
+def swallow(f):
+    try:
+        return f()
+    except Exception:               # GL007: error dropped on the floor
+        return None
+
+
+def swallow_suppressed(f):
+    try:
+        return f()
+    except Exception:  # graftlint: disable=GL007(fixture: the audited suppressed occurrence)
+        return None
+
+
+def broad_but_recorded(f, log):
+    try:
+        return f()
+    except Exception as exc:        # ok: the bound exception is recorded
+        log(exc)
+        return None
+
+
 # ---- GL000 bad-suppression ---------------------------------------------
 
 x_no_reason = 1  # graftlint: disable=GL001

@@ -144,6 +144,28 @@ def test_analysis_gates_exist_and_stay_tier1():
             f"(they ARE the fast regression fence): {fname}::{slow}")
 
 
+# chaos-test gate (ISSUE 3): the fault-injection tests ARE the permanent
+# regression harness for the recovery paths (watchdog, finite guard,
+# rollback, ckpt retry) — and for PRs 1-2's hot-path guarantees holding
+# UNDER injected faults.  Like the analysis gates, they only guard if
+# they run on every default `pytest`: never @slow, never vanished.
+_CHAOS_GATES = ("test_resilience.py",)
+
+
+def test_chaos_gates_exist_and_stay_tier1():
+    for fname in _CHAOS_GATES:
+        path = os.path.join(_TESTS, fname)
+        assert os.path.exists(path), f"chaos gate {fname} is missing"
+        src = open(path).read()
+        tests = list(_iter_tests(ast.parse(src)))
+        assert tests, f"{fname} defines no tests"
+        slow = [node.name for node, class_slow in tests
+                if _is_slow_marked(node, class_slow)]
+        assert not slow, (
+            "chaos tests must be tier-1/CPU-safe, never @slow (they are "
+            f"the fault-path regression fence): {fname}::{slow}")
+
+
 def test_autotune_artifact_carries_generator_key():
     """The JSON impl-map artifact can't carry a markdown header; its
     'generator' key is the same contract."""

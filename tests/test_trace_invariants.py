@@ -27,12 +27,14 @@ def test_all_registered_entry_invariants_hold():
     assert not bad, "trace invariants violated:\n" + "\n".join(bad)
     # required coverage: train step, softdtw, retrieval (the ISSUE floor)
     entries = {r.entry for r in results}
-    assert {"train_step_milnce", "train_step_sdtw3",
+    assert {"train_step_milnce", "train_step_milnce_guarded",
+            "train_step_sdtw3",
             "grad_cache_step_milnce", "video_embed", "text_embed",
             "softdtw_scan_grad", "param_treedef"} <= entries
     # the double-call recompile detector ran on every executable entry
     recompiled = {r.entry for r in results if r.check == "recompile"}
-    assert {"train_step_milnce", "video_embed", "text_embed",
+    assert {"train_step_milnce", "train_step_milnce_guarded",
+            "video_embed", "text_embed",
             "softdtw_scan_grad"} <= recompiled
 
 
