@@ -1,7 +1,8 @@
 """graftlint Pass 2: trace-level invariants over the registered entry points.
 
 Where Pass 1 reads source, this pass reads *jaxprs*: every hot-path entry
-point (train step variants, soft-DTW ops, eval retrieval embedders) is
+point (train step variants, soft-DTW ops, eval retrieval embedders, the
+serving engine's bucket ladder + sharded top-k retrieval) is
 traced on a hermetic CPU mesh (the same 8-virtual-device layout the test
 suite uses) and checked for the regressions that erase TPU throughput
 without failing any functional test:
@@ -58,6 +59,14 @@ EXPECTED_COLLECTIVES = {
     "video_embed": {},
     "text_embed": {},
     "softdtw_scan_grad": {},
+    # serving (ISSUE 4): the engine's embed entries are the same
+    # shard_map programs as offline eval — collective-free by
+    # construction; the sharded top-k retrieval ships exactly the two
+    # (Q, k) candidate gathers (scores + global indices), never the
+    # (Q, R_local) score matrix
+    "serve_text_embed": {},
+    "serve_video_embed": {},
+    "serve_index_topk": {"all_gather": 2},
 }
 
 
@@ -324,6 +333,79 @@ def _entry_param_treedef() -> list[CheckResult]:
     return out
 
 
+def _entry_serve_embed_ladder() -> list[CheckResult]:
+    """The serving engine's no-recompile-across-the-bucket-ladder gate
+    (ISSUE 4 acceptance): after the startup warmup sweep, a FULL sweep of
+    both embed entries over every bucket — including non-bucket request
+    sizes that pad up — must create zero new jit-cache entries.  Also
+    pins the entries' jaxprs collective-free at the top bucket."""
+    import numpy as np
+
+    from milnce_tpu.serving.engine import InferenceEngine
+
+    model, _opt, mesh, state, _batch = _setup()
+    varz = {"params": state.params, "batch_stats": state.batch_stats}
+    import jax
+
+    ndev = len(jax.devices())
+    engine = InferenceEngine(model, varz, mesh, text_words=_WORDS,
+                             video_shape=(_FRAMES, _SIZE, _SIZE, 3),
+                             max_batch=2 * ndev)   # 2-rung ladder
+    rng = np.random.default_rng(0)
+    sizes = list(engine.buckets) + [1, engine.buckets[0] + 1]  # pad paths
+    for n in sizes:
+        engine.embed_text(rng.integers(
+            0, _TINY["vocab_size"], (n, _WORDS)).astype(np.int32))
+        engine.embed_video(rng.integers(
+            0, 255, (n, _FRAMES, _SIZE, _SIZE, 3), dtype=np.uint8))
+    n_re = engine.recompiles()
+    out = [CheckResult(
+        "serve_embed_ladder", "recompile", n_re == 0,
+        "" if n_re == 0 else f"{n_re} jit-cache entries appeared AFTER the "
+        "warmup bucket sweep — a request shape is escaping the ladder "
+        "(weak-type drift, or a pad path missing)")]
+    b = engine.buckets[-1]
+    out += _jaxpr_checks("serve_text_embed", engine._text_fn,
+                         (varz, np.zeros((b, _WORDS), np.int32)))
+    out += _jaxpr_checks("serve_video_embed", engine._video_fn,
+                         (varz, np.zeros((b, _FRAMES, _SIZE, _SIZE, 3),
+                                         np.uint8)))
+    return out
+
+
+def _entry_serve_index_topk() -> list[CheckResult]:
+    """Sharded retrieval: exactly 2 all_gathers (the (Q, k) score and
+    index candidate lists), no f64, and the double-call recompile check
+    on the jitted top-k program."""
+    import jax
+    import numpy as np
+
+    from milnce_tpu.serving.index import DeviceRetrievalIndex
+
+    _model, _opt, mesh, _state, _batch = _setup()
+    ndev = len(jax.devices())
+    rng = np.random.default_rng(0)
+    corpus = rng.standard_normal((3 * ndev - 2, _TINY["embedding_dim"]))
+    index = DeviceRetrievalIndex(mesh, corpus.astype(np.float32), k=3,
+                                 query_buckets=(ndev,))
+    name = "serve_index_topk"
+
+    def make_q(seed):
+        # committed to the index's replicated query sharding — an
+        # uncommitted host array would key a SEPARATE jit-cache entry
+        # and false-positive the recompile detector
+        r = np.random.default_rng(seed)
+        return jax.device_put(
+            r.standard_normal((ndev, index.dim)).astype(np.float32),
+            index._query_sh)
+
+    out = _jaxpr_checks(name, index._fn,
+                        (index._corpus, index._valid, make_q(0)))
+    out.append(_recompile_check(
+        name, index._fn, lambda s: (index._corpus, index._valid, make_q(s))))
+    return out
+
+
 ENTRY_POINTS = {
     "train_step_milnce": _entry_train_step_milnce,
     "train_step_milnce_guarded": _entry_train_step_milnce_guarded,
@@ -332,6 +414,8 @@ ENTRY_POINTS = {
     "retrieval_embed": _entry_retrieval_embed,
     "softdtw_scan": _entry_softdtw_scan,
     "param_treedef": _entry_param_treedef,
+    "serve_embed_ladder": _entry_serve_embed_ladder,
+    "serve_index_topk": _entry_serve_index_topk,
 }
 
 

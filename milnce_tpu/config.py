@@ -268,6 +268,38 @@ class TrainConfig:
 
 
 @dataclass
+class ServeConfig:
+    """Online-serving knobs (milnce_tpu/serving/, SERVING.md).
+
+    The three SLO levers: ``max_batch`` trades per-request latency for
+    device efficiency (taller ladder = fuller MXU at high load),
+    ``max_delay_ms`` bounds how long a lone request waits for batch
+    company, ``default_timeout_ms`` bounds total queue wait before a
+    request errors (DeadlineExpired) instead of silently aging."""
+
+    max_batch: int = 64                 # top of the bucket ladder
+    min_bucket: int = 0                 # smallest bucket (0 = mesh size)
+    max_delay_ms: float = 5.0           # batcher flush-on-delay bound
+    default_timeout_ms: float = 0.0     # per-request queue deadline (0 = none)
+    cache_capacity: int = 4096          # LRU text-embedding cache entries
+                                        # (<= 0 disables)
+    topk: int = 10                      # retrieval depth (static in the
+                                        # traced top-k program)
+    dtype: str = ""                     # serve-time cast ('bfloat16' for
+                                        # MXU-rate inference; '' = exported)
+    host: str = "127.0.0.1"
+    port: int = 8000
+    export_dir: str = ""                # milnce-export artifact to serve
+    corpus_npz: str = ""                # (N, D) f32 corpus embeddings to
+                                        # index ('' = embed-only service)
+    token_dict_path: str = ""           # dict.npy vocab for serve-time
+                                        # sentence tokenization ('' = the
+                                        # path recorded in the export's
+                                        # metadata; without either, only
+                                        # token_ids requests work)
+
+
+@dataclass
 class Config:
     data: DataConfig = field(default_factory=DataConfig)
     model: ModelConfig = field(default_factory=ModelConfig)
@@ -275,6 +307,7 @@ class Config:
     optim: OptimConfig = field(default_factory=OptimConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
 
 
 def full_preset() -> Config:

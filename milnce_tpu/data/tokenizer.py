@@ -12,6 +12,23 @@ s3dg.py:164-194 and video_loader.py:97-117:
 
 Host-side, numpy-only: tokenization happens in the input pipeline, never
 under jit.
+
+Thread safety (audited for the concurrent serving request path,
+ISSUE 4): a :class:`Tokenizer` is safe for unlimited concurrent
+``encode`` / ``encode_batch`` calls WITHOUT external locking —
+
+- ``word_to_id`` and ``max_words`` are written once in ``__init__`` and
+  only read afterwards (no method mutates instance state);
+- the module-level ``_WORD_RE`` compiled pattern is stateless per call
+  (CPython ``re`` pattern objects are documented thread-safe);
+- every call builds fresh local lists/arrays; nothing is shared between
+  calls.
+
+The one excluded pattern: mutating ``word_to_id`` / ``max_words`` after
+construction while requests are in flight — build a NEW Tokenizer and
+swap the reference instead (reference assignment is atomic).  Pinned by
+the hammer test (tests/test_tokenizer.py: N threads x concurrent
+encodes == serial goldens).
 """
 
 from __future__ import annotations

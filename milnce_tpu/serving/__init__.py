@@ -1,0 +1,24 @@
+"""Online text->video retrieval serving (SERVING.md).
+
+The training side of this repo produces frozen parameters; this package
+turns them into a production inference path the ROADMAP's north star
+demands: a bucketed, pre-traced, transfer-guarded embedding engine
+(`engine`), a dynamic micro-batcher with per-request deadlines
+(`batcher`), an LRU text-embedding cache (`cache`), a device-resident
+sharded retrieval index (`index`), a stdlib HTTP/JSON front
+(`service`), and the params-only export that feeds it (`export`).
+
+Import discipline: `batcher` and `cache` are numpy-only (usable, and
+testable, without jax); `engine`/`index` own every device interaction
+and keep the steady state free of implicit transfers and recompiles —
+the serve entries are pinned by `analysis/trace_invariants.py`.
+"""
+
+from milnce_tpu.serving.batcher import DeadlineExpired, DynamicBatcher
+from milnce_tpu.serving.cache import EmbeddingLRUCache
+
+__all__ = [
+    "DeadlineExpired",
+    "DynamicBatcher",
+    "EmbeddingLRUCache",
+]

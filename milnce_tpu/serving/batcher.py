@@ -1,0 +1,243 @@
+"""Dynamic micro-batcher: request queue -> padded bucket -> per-request
+results.
+
+The serving engine (engine.py) only executes fixed, pre-traced batch
+shapes (the bucket ladder); individual requests arrive one row at a
+time.  This module is the shim between the two worlds: a worker thread
+drains a queue, groups rows into a batch, pads the batch to the
+smallest bucket that fits, runs it, and scatters per-row results back
+to the callers' futures.
+
+Flush policy (both bounds are SLO knobs, SERVING.md):
+
+- **size**: a batch flushes as soon as ``max_batch`` rows are waiting —
+  never pads past the top bucket;
+- **delay**: a batch flushes at most ``max_delay_ms`` after its FIRST
+  row arrived — a lone request never waits longer than the delay bound
+  for company.
+
+Deadline semantics (the request-path analogue of the training side's
+decode watchdog, ROBUSTNESS.md): a request may carry a deadline that
+bounds its QUEUE WAIT.  A request whose deadline passes before its
+batch runs completes with :class:`DeadlineExpired` — an error the
+caller sees, never a silent drop — and the worker wakes early at the
+nearest pending deadline so expiry is prompt, not discovered at the
+next size/delay flush.  A deadline does NOT abort device work already
+in flight: once a batch is submitted its rows get their results.
+
+numpy-only on purpose: payloads and results are host arrays; every
+device interaction lives behind the injected ``run_batch`` callable.
+Thread safety: ``submit`` may be called from any number of threads;
+one worker thread owns the flush path; counters are lock-guarded.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import numpy as np
+
+# The worker wakes this soon after the nearest deadline so an expired
+# request fails promptly (bounded staleness of the expiry verdict).
+_DEADLINE_SLACK_S = 0.002
+# Idle poll period: how often the worker re-checks the closed flag when
+# the queue is empty (bounds close() latency, costs nothing hot).
+_IDLE_POLL_S = 0.05
+
+
+class DeadlineExpired(RuntimeError):
+    """The request's deadline passed while it was still queued."""
+
+
+def pad_rows(rows: np.ndarray, bucket: int) -> np.ndarray:
+    """Zero-pad ``(n, ...)`` rows up to ``(bucket, ...)`` on axis 0
+    (no-op when already at the bucket).  THE pad rule of the serve path
+    — batcher, engine and index all share it so it cannot diverge."""
+    n = rows.shape[0]
+    if bucket <= n:
+        return rows
+    pad = np.zeros((bucket - n,) + rows.shape[1:], dtype=rows.dtype)
+    return np.concatenate([rows, pad], axis=0)
+
+
+@dataclass
+class _Request:
+    payload: np.ndarray
+    future: Future
+    deadline: Optional[float]        # absolute time.monotonic() seconds
+
+
+class DynamicBatcher:
+    """Queue + worker thread turning single-row submits into bucket-padded
+    batch executions.
+
+    - ``run_batch(padded (bucket, ...)) -> (bucket, D)``: the batch
+      executor (e.g. ``InferenceEngine.embed_text``).  Row ``i`` of the
+      output must correspond to row ``i`` of the input — the pad/unpad
+      identity the batcher relies on (pinned by tests).
+    - ``bucket_for(n) -> bucket >= n``: the engine's ladder lookup.
+    - ``max_batch``: size-flush threshold (== the top bucket).
+    - ``max_delay_ms``: delay-flush bound.
+    - ``default_timeout_ms``: deadline applied to submits that don't pass
+      their own; 0 disables.
+    """
+
+    def __init__(self, run_batch: Callable[[np.ndarray], np.ndarray],
+                 bucket_for: Callable[[int], int], *, max_batch: int,
+                 max_delay_ms: float = 5.0, default_timeout_ms: float = 0.0,
+                 name: str = "batcher"):
+        assert max_batch >= 1
+        self._run_batch = run_batch
+        self._bucket_for = bucket_for
+        self.max_batch = int(max_batch)
+        self.max_delay_s = float(max_delay_ms) / 1000.0
+        self.default_timeout_ms = float(default_timeout_ms)
+        self._q: queue.Queue[_Request] = queue.Queue()
+        self._lock = threading.Lock()
+        self._closed = threading.Event()
+        self._requests = 0
+        self._flushes = 0
+        self._expired = 0
+        self._batch_errors = 0
+        self._occupancy: dict[int, list[int]] = {}   # bucket -> [flushes, rows]
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name=f"{name}-worker")
+        self._worker.start()
+
+    # ---- client side ----------------------------------------------------
+
+    def submit(self, payload: np.ndarray,
+               timeout_ms: Optional[float] = None) -> Future:
+        """Enqueue one row; returns a Future resolving to its result row.
+
+        ``timeout_ms``: deadline for THIS request (None = the batcher
+        default; <= 0 = no deadline)."""
+        if self._closed.is_set():
+            raise RuntimeError("batcher is closed")
+        t_ms = self.default_timeout_ms if timeout_ms is None else timeout_ms
+        deadline = (time.monotonic() + t_ms / 1000.0) if t_ms > 0 else None
+        fut: Future = Future()
+        with self._lock:
+            self._requests += 1
+        self._q.put(_Request(np.asarray(payload), fut, deadline))
+        if self._closed.is_set():
+            # close() raced the put above: the worker may already have
+            # drained and exited, so this request would hang forever —
+            # sweep the queue from here (idempotent, InvalidStateError-
+            # safe) so the future resolves either way
+            self._drain_closed()
+        return fut
+
+    # ---- worker side ----------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._closed.is_set():
+            try:
+                first = self._q.get(timeout=_IDLE_POLL_S)
+            except queue.Empty:
+                continue
+            batch = [first]
+            flush_at = time.monotonic() + self.max_delay_s
+            while len(batch) < self.max_batch:
+                wake = flush_at
+                for r in batch:
+                    if r.deadline is not None:
+                        wake = min(wake, r.deadline + _DEADLINE_SLACK_S)
+                remaining = wake - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._q.get(timeout=remaining))
+                except queue.Empty:
+                    break        # woke at flush_at or a pending deadline
+            self._flush(batch)
+        self._drain_closed()
+
+    def _flush(self, batch: list[_Request]) -> None:
+        now = time.monotonic()
+        live = []
+        expired = 0
+        for r in batch:
+            if r.deadline is not None and r.deadline < now:
+                r.future.set_exception(DeadlineExpired(
+                    f"deadline exceeded by {self._past_ms(r, now):.1f} ms "
+                    "while queued (request was never batched)"))
+                expired += 1
+            else:
+                live.append(r)
+        if expired:
+            with self._lock:
+                self._expired += expired
+        if not live:
+            return
+        n = len(live)
+        try:
+            # the whole batch computation is inside the try: a bad
+            # payload (mixed row shapes -> np.stack raises) must fail
+            # THIS batch's futures, never kill the worker thread — a
+            # dead worker would strand every later submit forever
+            bucket = self._bucket_for(n)
+            rows = pad_rows(np.stack([r.payload for r in live]), bucket)
+            out = np.asarray(self._run_batch(rows))
+        except Exception as exc:
+            # batch failure -> every caller sees the error (never a hang)
+            for r in live:
+                r.future.set_exception(exc)
+            with self._lock:
+                self._batch_errors += 1
+            return
+        for i, r in enumerate(live):
+            r.future.set_result(out[i])
+        with self._lock:
+            self._flushes += 1
+            ent = self._occupancy.setdefault(bucket, [0, 0])
+            ent[0] += 1
+            ent[1] += n
+
+    @staticmethod
+    def _past_ms(r: _Request, now: float) -> float:
+        return max(0.0, (now - r.deadline) * 1000.0) if r.deadline else 0.0
+
+    def _drain_closed(self) -> None:
+        """Fail (never drop) anything still queued when the batcher
+        closes.  Callable from both the exiting worker and a racing
+        ``submit`` thread — double-resolution is tolerated."""
+        from concurrent.futures import InvalidStateError
+
+        while True:
+            try:
+                r = self._q.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                r.future.set_exception(RuntimeError("batcher closed"))
+            except InvalidStateError:
+                pass                    # the other drainer got it first
+
+    # ---- lifecycle / observability --------------------------------------
+
+    def close(self, timeout: float = 5.0) -> None:
+        self._closed.set()
+        self._worker.join(timeout)
+
+    def stats(self) -> dict:
+        """Counters + the batch-occupancy histogram (bucket -> how full
+        batches ran) — the number that tells you whether max_delay_ms is
+        tuned right for the offered load."""
+        with self._lock:
+            occupancy = {
+                str(b): {"flushes": f, "rows": rows,
+                         "mean_fill": (rows / (f * b)) if f else 0.0}
+                for b, (f, rows) in sorted(self._occupancy.items())}
+            return {
+                "requests": self._requests,
+                "flushes": self._flushes,
+                "deadline_expired": self._expired,
+                "batch_errors": self._batch_errors,
+                "occupancy": occupancy,
+            }

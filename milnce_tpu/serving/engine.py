@@ -1,0 +1,253 @@
+"""Frozen-param inference engine: pre-traced bucket ladder, transfer-
+guarded steady state.
+
+What JAX/XLA rewards at serve time is exactly what Neodragon and
+On-device Sora (PAPERS.md) report for video-model serving: fixed-shape
+pre-traced execution and aggressive reuse — never a runtime recompile,
+never an accidental host round-trip.  This engine packages the repo's
+existing embed towers (train/step.py ``make_text_embed_fn`` /
+``make_video_embed_fn`` — the same jitted shard_map programs offline
+eval uses, so served numbers ARE eval numbers) behind that discipline:
+
+- **bucket ladder**: batch entries exist only at a power-of-two ladder
+  of batch sizes (each a multiple of the mesh's data-axis extent, so
+  every bucket shards).  Requests are padded UP to the smallest bucket
+  that fits; the jit cache therefore holds exactly
+  ``len(buckets) x 2`` executables forever.
+- **pre-trace at startup**: every (entry, bucket) pair is compiled and
+  executed once in ``__init__`` — first-request latency is steady-state
+  latency, and a compile storm can only happen where it belongs: at
+  boot, visibly.
+- **steady state under ``jax.transfer_guard("disallow")``**: inputs go
+  up via explicit ``device_put`` against the batch sharding, results
+  come back via explicit ``device_get``; anything else — a smuggled
+  implicit H2D in a future edit — raises instead of silently stalling
+  the dispatch pipeline (same contract as the train loop,
+  tests/test_transfer_guard.py).
+- **recompile accounting**: jit cache sizes are snapshotted after the
+  warmup sweep; :meth:`recompiles` must stay 0 for the life of the
+  process (pinned by the ``serve_embed_ladder`` trace invariant and
+  surfaced by the service health endpoint).
+
+Frozen params: the engine holds ``{'params', 'batch_stats'}`` only (no
+optimizer state — see serving/export.py), replicated onto the mesh once
+at construction, optionally cast to bf16 for MXU-rate inference.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from milnce_tpu.parallel.mesh import batch_sharding, replicated
+from milnce_tpu.serving.batcher import pad_rows
+from milnce_tpu.train.step import make_text_embed_fn, make_video_embed_fn
+
+# One device-dispatch queue per process, shared by every serving
+# component that executes on the mesh (engine entries AND index.topk).
+# Two reasons, one per backend: the multi-device XLA:CPU client
+# DEADLOCKS when multi-device executions + transfers are issued
+# concurrently from several host threads (observed: N request threads
+# wedged in device_get while the batcher worker wedges in execute); and
+# on TPU, concurrent host threads racing enqueues just interleave into
+# the single per-device execution queue anyway — serialized dispatch is
+# the semantics the hardware gives you, made explicit and deadlock-free.
+# Request-level concurrency belongs ABOVE this lock, in the batcher.
+DEVICE_DISPATCH_LOCK = threading.Lock()
+
+
+def bucket_ladder(n_dev: int, min_bucket: int, max_batch: int) -> tuple:
+    """Power-of-two batch buckets, each divisible by the mesh size.
+
+    Starts at the smallest power of two >= max(min_bucket, n_dev) and
+    doubles up to ``max_batch`` inclusive.  On a power-of-two mesh (the
+    only kind this repo runs) every rung then shards evenly."""
+    start = max(int(min_bucket) or n_dev, n_dev)
+    b = 1
+    while b < start:
+        b *= 2
+    if b % n_dev:
+        raise ValueError(
+            f"bucket {b} is not divisible by the {n_dev}-way data axis — "
+            "pick min_bucket as a multiple of the mesh size")
+    if b > max_batch:
+        raise ValueError(f"max_batch={max_batch} is below the smallest "
+                         f"shardable bucket {b} on a {n_dev}-device mesh")
+    out = []
+    while b <= max_batch:
+        out.append(b)
+        b *= 2
+    return tuple(out)
+
+
+def cast_floats(tree, dtype):
+    """Cast floating leaves of a pytree (params/batch_stats) to ``dtype``;
+    integer leaves (e.g. embedding ids baked into stats) pass through."""
+    dt = jnp.dtype(dtype)
+
+    def cast(x):
+        x = jnp.asarray(x)
+        return x.astype(dt) if jnp.issubdtype(x.dtype, jnp.floating) else x
+
+    return jax.tree_util.tree_map(cast, tree)
+
+
+class InferenceEngine:
+    """Bucketed, pre-traced, transfer-guarded embed entries over frozen
+    params.
+
+    - ``variables``: ``{'params': ..., 'batch_stats': ...}`` (params-only
+      inference checkpoint — serving/export.py round-trips one).
+    - ``text_words`` / ``video_shape``: the fixed per-row input shapes
+      ((W,) token ids / (T, H, W, 3) uint8 frames) the entries are traced
+      at; requests with any other trailing shape are rejected, they would
+      otherwise silently compile a new program.
+    - ``cast_dtype``: optional float dtype ('bfloat16') the frozen params
+      are cast to at load — the model itself must be built with the
+      matching compute dtype (``InferenceEngine.from_export`` wires both).
+    """
+
+    def __init__(self, model, variables, mesh: Mesh, *, text_words: int,
+                 video_shape: Sequence[int], max_batch: int = 64,
+                 min_bucket: int = 0, data_axis: str = "data",
+                 cast_dtype: Optional[str] = None, precompile: bool = True):
+        self.mesh = mesh
+        self.data_axis = data_axis
+        # batch divisibility is governed by the DATA axis extent alone:
+        # on a (data, model) mesh the embed programs shard rows over
+        # data and replicate over model (P(data) in/out specs)
+        n_dev = int(mesh.shape[data_axis])
+        self.buckets = bucket_ladder(n_dev, min_bucket, max_batch)
+        self.max_batch = self.buckets[-1]
+        self.text_words = int(text_words)
+        self.video_shape = tuple(int(d) for d in video_shape)
+        if cast_dtype:
+            variables = cast_floats(variables, cast_dtype)
+        # one explicit replication at boot; steady state never moves params
+        self._variables = jax.device_put(variables, replicated(mesh))
+        self._batch_sh = batch_sharding(mesh, data_axis)
+        self._text_fn = make_text_embed_fn(model, mesh, data_axis)
+        self._video_fn = make_video_embed_fn(model, mesh, data_axis)
+        self._calls: dict[tuple, int] = {}     # (entry, bucket) -> calls
+        self._baseline_cache: Optional[dict] = None
+        self.embed_dim: Optional[int] = None   # known after the first call
+        if precompile:
+            self.warmup()
+
+    # ---- bucket ladder ---------------------------------------------------
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket that fits ``n`` rows."""
+        if n < 1:
+            raise ValueError(f"batch of {n} rows")
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"{n} rows exceeds max_batch={self.max_batch} "
+                         "(split upstream, or rebuild with a taller ladder)")
+
+    # ---- entries ---------------------------------------------------------
+
+    def embed_text(self, token_ids: np.ndarray) -> np.ndarray:
+        """(n, W) int32 token ids -> (n, D) float embeddings; n is padded
+        to the bucket internally and unpadded on return."""
+        rows = np.ascontiguousarray(token_ids, dtype=np.int32)
+        if rows.ndim != 2 or rows.shape[1] != self.text_words:
+            raise ValueError(f"expected (n, {self.text_words}) token ids, "
+                             f"got {rows.shape}")
+        return self._run("text", self._text_fn, rows)
+
+    def embed_video(self, video_u8: np.ndarray) -> np.ndarray:
+        """(n, T, H, W, 3) uint8 frames -> (n, D) float embeddings."""
+        clips = np.ascontiguousarray(video_u8, dtype=np.uint8)
+        if clips.shape[1:] != self.video_shape:
+            raise ValueError(f"expected (n,) + {self.video_shape} uint8 "
+                             f"video, got {clips.shape}")
+        return self._run("video", self._video_fn, clips)
+
+    def _run(self, entry: str, fn, rows: np.ndarray) -> np.ndarray:
+        n = rows.shape[0]
+        bucket = self.bucket_for(n)
+        rows = pad_rows(rows, bucket)
+        # Steady state: implicit transfers are bugs (they stall the async
+        # dispatch pipeline); both legs of the request are explicit.
+        with DEVICE_DISPATCH_LOCK, jax.transfer_guard("disallow"):
+            x = jax.device_put(rows, self._batch_sh)
+            out = jax.device_get(fn(self._variables, x))
+        self._calls[(entry, bucket)] = self._calls.get((entry, bucket), 0) + 1
+        out = np.asarray(out)
+        self.embed_dim = int(out.shape[-1])
+        return out[:n]
+
+    # ---- warmup + recompile accounting -----------------------------------
+
+    def warmup(self) -> None:
+        """Sweep BOTH entries over the full bucket ladder so every
+        executable the engine will ever run exists before the first
+        request, then snapshot the jit cache sizes — any later growth is
+        a recompile (:meth:`recompiles`)."""
+        for b in self.buckets:
+            self.embed_text(np.zeros((b, self.text_words), np.int32))
+            self.embed_video(np.zeros((b,) + self.video_shape, np.uint8))
+        self._baseline_cache = self._cache_sizes()
+
+    def _cache_sizes(self) -> dict:
+        out = {}
+        for name, fn in (("text", self._text_fn), ("video", self._video_fn)):
+            size = getattr(fn, "_cache_size", None)
+            out[name] = int(size()) if size is not None else -1
+        return out
+
+    def recompiles(self) -> int:
+        """Jit-cache entries created SINCE the warmup sweep — 0 in a
+        healthy steady state (pinned by the serve_embed_ladder trace
+        invariant).  -1 when this jax build has no cache introspection."""
+        if self._baseline_cache is None:
+            return -1
+        now = self._cache_sizes()
+        if -1 in now.values() or -1 in self._baseline_cache.values():
+            return -1
+        return sum(max(0, now[k] - self._baseline_cache[k]) for k in now)
+
+    def stats(self) -> dict:
+        return {
+            "buckets": list(self.buckets),
+            "max_batch": self.max_batch,
+            "recompiles": self.recompiles(),
+            "calls": {f"{entry}@{bucket}": n
+                      for (entry, bucket), n in sorted(self._calls.items())},
+        }
+
+    # ---- construction from a frozen export -------------------------------
+
+    @classmethod
+    def from_export(cls, export_dir: str, mesh: Mesh, *, dtype: str = "",
+                    max_batch: int = 64, min_bucket: int = 0,
+                    data_axis: str = "data", precompile: bool = True
+                    ) -> "InferenceEngine":
+        """Build model + engine from a ``milnce-export`` directory.
+
+        ``dtype`` overrides the exported compute dtype ('bfloat16' casts
+        the frozen params AND builds the model at bf16 — the MXU-rate
+        deployment mode; '' keeps the exported dtype)."""
+        from milnce_tpu.config import ModelConfig
+        from milnce_tpu.models.build import build_model
+        from milnce_tpu.serving.export import load_inference_checkpoint
+
+        meta, variables = load_inference_checkpoint(export_dir)
+        model_cfg = ModelConfig(**meta["model"])
+        if dtype:
+            model_cfg.dtype = dtype
+        model = build_model(model_cfg)
+        return cls(model, variables, mesh,
+                   text_words=meta["tokenizer"]["max_words"],
+                   video_shape=meta["video_shape"],
+                   max_batch=max_batch, min_bucket=min_bucket,
+                   data_axis=data_axis,
+                   cast_dtype=(dtype or None), precompile=precompile)

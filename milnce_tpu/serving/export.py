@@ -1,0 +1,198 @@
+"""Params-only frozen export: training checkpoint -> inference artifact.
+
+A training checkpoint (Orbax, train/checkpoint.py) carries the full
+``TrainState`` — params, BatchNorm stats, AND the optimizer moments,
+which for Adam are 2x the params and pure dead weight at serve time.
+This module writes the inference subset in a deliberately boring
+format: one ``arrays.npz`` (flattened ``params`` + ``batch_stats``
+leaves, '/'-joined tree paths as keys) plus one ``metadata.json``
+(model config, tokenizer contract, per-clip video shape) — loadable on
+any host with numpy, no Orbax, no original mesh, no model code at read
+time.
+
+Arrays are stored float32; casting to bf16 is a LOAD-time decision
+(``InferenceEngine.from_export(dtype='bfloat16')``) so one artifact
+serves both precision modes ("bf16-castable", not bf16-committed).
+
+CLI (console script ``milnce-export`` /
+``python -m milnce_tpu.serving.export``)::
+
+    milnce-export --checkpoint_dir checkpoint/run1 --out export/run1 \\
+        --preset small [--epoch 7] [--model.embedding_dim 512 ...]
+
+The model/data flags mirror the trainer CLI: the checkpoint stores only
+arrays, so the exporter must be told the same model config the run was
+trained with (preset + overrides), and bakes it into the artifact —
+the serving host never guesses shapes again.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+ARRAYS_FILE = "arrays.npz"
+METADATA_FILE = "metadata.json"
+FORMAT_VERSION = 1
+
+
+def _key_name(k) -> str:
+    for attr in ("key", "name", "idx"):
+        if hasattr(k, attr):
+            return str(getattr(k, attr))
+    return str(k)
+
+
+def _flatten(tree, prefix: str) -> dict[str, np.ndarray]:
+    """Pytree -> {'prefix/path/to/leaf': np.ndarray}."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = {}
+    for path, leaf in flat:
+        key = "/".join([prefix] + [_key_name(p) for p in path])
+        out[key] = np.asarray(leaf)
+    return out
+
+
+def _unflatten(arrays: dict[str, np.ndarray], prefix: str) -> dict:
+    """Inverse of :func:`_flatten` for dict-shaped trees (flax params /
+    batch_stats are nested string-keyed dicts)."""
+    root: dict = {}
+    for key, value in arrays.items():
+        parts = key.split("/")
+        if parts[0] != prefix:
+            continue
+        node = root
+        for p in parts[1:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return root
+
+
+def export_inference_checkpoint(out_dir: str, params, batch_stats,
+                                model_cfg, *, max_words: int,
+                                video_shape, step: int = 0,
+                                source: str = "") -> str:
+    """Write the frozen artifact; returns ``out_dir``.
+
+    ``model_cfg`` is a ``milnce_tpu.config.ModelConfig``; host-specific
+    fields (word2vec/token-dict paths, impl-map file paths) are
+    sanitized so the artifact is self-contained."""
+    from milnce_tpu.config import parse_conv_impl_map
+
+    os.makedirs(out_dir, exist_ok=True)
+    arrays = _flatten(params, "params")
+    arrays.update(_flatten(batch_stats, "batch_stats"))
+    # float leaves stored f32 (bf16 is a load-time cast; f64 never ships)
+    arrays = {k: (v.astype(np.float32)
+                  if np.issubdtype(v.dtype, np.floating) else v)
+              for k, v in arrays.items()}
+    np.savez(os.path.join(out_dir, ARRAYS_FILE), **arrays)
+
+    model_meta = dataclasses.asdict(model_cfg)
+    model_meta["word2vec_path"] = ""        # table already lives in params
+    impl_map = parse_conv_impl_map(model_meta.get("conv_impl_map", ""))
+    model_meta["conv_impl_map"] = ",".join(  # resolve file specs inline
+        f"{s}={i}" for s, i in sorted(impl_map.items()))
+    token_dict = model_meta.pop("token_dict_path", "")
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "generator": "milnce-export (milnce_tpu/serving/export.py)",
+        "step": int(step),
+        "source_checkpoint": source,
+        "model": model_meta,
+        "tokenizer": {"max_words": int(max_words),
+                      "vocab_size": int(model_meta["vocab_size"]),
+                      "token_dict_path": token_dict},
+        "video_shape": [int(d) for d in video_shape],
+        "param_bytes": int(sum(v.nbytes for v in arrays.values())),
+    }
+    with open(os.path.join(out_dir, METADATA_FILE), "w") as fh:
+        json.dump(meta, fh, indent=2, sort_keys=True)
+    return out_dir
+
+
+def load_inference_checkpoint(export_dir: str) -> tuple[dict, dict]:
+    """Read an export -> (metadata dict, ``{'params', 'batch_stats'}``
+    variables tree of host numpy arrays)."""
+    with open(os.path.join(export_dir, METADATA_FILE)) as fh:
+        meta = json.load(fh)
+    if meta.get("format_version") != FORMAT_VERSION:
+        raise ValueError(f"export format {meta.get('format_version')!r} "
+                         f"unsupported (this build reads {FORMAT_VERSION})")
+    # ModelConfig round-trips through JSON minus the serve-sanitized field
+    meta["model"].pop("token_dict_path", None)
+    with np.load(os.path.join(export_dir, ARRAYS_FILE)) as z:
+        arrays = {k: z[k] for k in z.files}
+    return meta, {"params": _unflatten(arrays, "params"),
+                  "batch_stats": _unflatten(arrays, "batch_stats")}
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _restore_inference_subset(checkpoint_dir: str,
+                              epoch: Optional[int]) -> tuple[int, dict]:
+    """(step, {'params', 'batch_stats'}) from a training run directory —
+    metadata-templated restore, so no model build and no optimizer I/O."""
+    from milnce_tpu.train.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(checkpoint_dir, create=False)
+    try:
+        label, raw = mgr.restore_raw(epoch,
+                                     subtrees={"step", "params",
+                                               "batch_stats"})
+    finally:
+        mgr.close()
+    if not isinstance(raw, dict):           # TrainState restored as object
+        raw = {"step": raw.step, "params": raw.params,
+               "batch_stats": raw.batch_stats}
+    step = int(np.asarray(raw["step"])) if "step" in raw else int(label)
+    return step, {"params": raw["params"],
+                  "batch_stats": raw.get("batch_stats", {})}
+
+
+def main(argv=None) -> None:
+    from milnce_tpu.config import PRESETS, _add_dataclass_args
+
+    ap = argparse.ArgumentParser(
+        description="Export a params-only inference checkpoint "
+                    "(milnce_tpu/serving/export.py)")
+    ap.add_argument("--checkpoint_dir", required=True,
+                    help="training run directory (Orbax)")
+    ap.add_argument("--out", required=True, help="export directory to write")
+    ap.add_argument("--epoch", type=int, default=None,
+                    help="checkpoint label to export (default: latest)")
+    ap.add_argument("--preset", choices=sorted(PRESETS), default="full",
+                    help="model/data config the run was trained with")
+    base = PRESETS["full"]()
+    _add_dataclass_args(ap, "model.", base.model)
+    _add_dataclass_args(ap, "data.", base.data)
+    ns = ap.parse_args(argv)
+
+    cfg = PRESETS[ns.preset]()
+    for key, val in vars(ns).items():
+        if "." in key and val is not None:
+            section, _, fname = key.partition(".")
+            setattr(getattr(cfg, section), fname, val)
+
+    step, tree = _restore_inference_subset(ns.checkpoint_dir, ns.epoch)
+    video_shape = (cfg.data.num_frames, cfg.data.video_size,
+                   cfg.data.video_size, 3)
+    out = export_inference_checkpoint(
+        ns.out, tree["params"], tree["batch_stats"], cfg.model,
+        max_words=cfg.data.max_words, video_shape=video_shape, step=step,
+        source=os.path.abspath(ns.checkpoint_dir))
+    meta_path = os.path.join(out, METADATA_FILE)
+    print(f"exported step {step} -> {out} ({meta_path})")
+
+
+if __name__ == "__main__":
+    main()

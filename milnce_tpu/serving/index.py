@@ -1,0 +1,159 @@
+"""Device-resident retrieval index: corpus embeddings sharded over the
+mesh data axis, jitted dot-product + ``lax.top_k`` retrieval.
+
+Offline eval materializes the full T x V similarity matrix on host
+(eval/retrieval.py) — fine for a 1k-video benchmark, hopeless for a
+served corpus: at production scale the corpus embedding table is the
+largest tensor in the system and must live ON the devices, sharded,
+with only (Q, k) winners ever crossing back to host.
+
+The retrieval program (one jitted shard_map, fixed shapes, pinned
+collectives — see the ``serve_index_topk`` trace invariant):
+
+1. each shard scores the replicated query block against its local
+   corpus rows (one (Q, R_local) matmul — MXU work, embarrassingly
+   parallel);
+2. pad rows are masked to -inf and each shard takes a LOCAL top-k,
+   shifting to global row indices via ``axis_index`` — this is the
+   communication win: per shard only (Q, k) survives, not (Q, R_local);
+3. the per-shard candidate lists ride ONE all_gather each for scores
+   and indices (2 total, pinned), and a final top-k over the
+   ``n_dev * k`` candidates is exact — every true global winner is
+   necessarily some shard's local winner.
+
+Query batches are padded to a fixed bucket ladder exactly like the
+embed entries (pad queries produce garbage rows that are dropped on
+unpad; they never affect real rows), so the whole serve path —
+embed + retrieve — runs zero recompiles after boot.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from milnce_tpu.parallel.compat import shard_map
+from milnce_tpu.parallel.mesh import batch_sharding, replicated
+from milnce_tpu.serving.batcher import pad_rows
+from milnce_tpu.serving.engine import DEVICE_DISPATCH_LOCK
+
+
+class DeviceRetrievalIndex:
+    """Immutable sharded corpus + fixed-k jitted top-k retrieval.
+
+    - ``embeddings``: (N, D) float32 video-corpus embeddings (built from
+      ``InferenceEngine.embed_video`` or an offline extraction);
+    - ``k``: retrieval depth, static in the traced program;
+    - ``query_buckets``: the query-batch ladder to pre-trace (share the
+      engine's so batcher output feeds straight through).
+    """
+
+    def __init__(self, mesh: Mesh, embeddings: np.ndarray, *, k: int = 10,
+                 query_buckets: Sequence[int] = (8,), data_axis: str = "data",
+                 precompile: bool = True):
+        emb = np.ascontiguousarray(embeddings, dtype=np.float32)
+        if emb.ndim != 2:
+            raise ValueError(f"expected (N, D) embeddings, got {emb.shape}")
+        self.size, self.dim = emb.shape
+        self.k = int(k)
+        if not 1 <= self.k <= self.size:
+            raise ValueError(f"k={k} outside [1, corpus size {self.size}]")
+        self.query_buckets = tuple(sorted(int(b) for b in query_buckets))
+        self.data_axis = data_axis
+        # geometry follows the DATA axis extent, not the total device
+        # count: P(data) shards rows over data and replicates over any
+        # model axis, so each data shard holds rows (not rows/model) —
+        # sizing by the product would mis-mask most of the corpus on a
+        # (data, model) mesh
+        n_data = int(mesh.shape[data_axis])
+
+        # Pad the corpus so rows split evenly AND every shard holds at
+        # least k rows (lax.top_k needs k <= local extent).
+        rows = max(-(-self.size // n_data), self.k)
+        total = rows * n_data
+        corpus = np.zeros((total, self.dim), np.float32)
+        corpus[:self.size] = emb
+        valid = np.asarray(
+            [max(0, min(self.size, (s + 1) * rows) - s * rows)
+             for s in range(n_data)], np.int32)
+
+        sh_rows = batch_sharding(mesh, data_axis)
+        self._corpus = jax.device_put(corpus, sh_rows)       # device-resident
+        self._valid = jax.device_put(valid, sh_rows)
+        self._query_sh = replicated(mesh)
+        k_ = self.k
+
+        def local_topk(corpus_l, valid_l, queries):
+            scores = queries @ corpus_l.T                    # (Q, R_local)
+            col = lax.iota(jnp.int32, corpus_l.shape[0])
+            scores = jnp.where(col[None, :] < valid_l[0], scores, -jnp.inf)
+            s, i = lax.top_k(scores, k_)                     # local winners
+            gidx = i + lax.axis_index(data_axis) * corpus_l.shape[0]
+            s_all = lax.all_gather(s, data_axis, axis=1, tiled=True)
+            i_all = lax.all_gather(gidx, data_axis, axis=1, tiled=True)
+            s_top, j = lax.top_k(s_all, k_)                  # exact global
+            return s_top, jnp.take_along_axis(i_all, j, axis=1)
+
+        self._fn = jax.jit(shard_map(
+            local_topk, mesh=mesh,
+            in_specs=(P(data_axis), P(data_axis), P()),
+            out_specs=(P(), P()), check_vma=False))
+        self._calls = 0
+        self._baseline_cache = None
+        if precompile:
+            self.warmup()
+
+    # ---- query path ------------------------------------------------------
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.query_buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"{n} queries exceeds the top query bucket "
+                         f"{self.query_buckets[-1]}")
+
+    def topk(self, queries: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """(n, D) query embeddings -> ((n, k) scores, (n, k) corpus row
+        indices), ranked best-first.  Ties broken by lower index, the
+        same order ``np.argsort(-sim)`` yields on distinct scores."""
+        q = np.ascontiguousarray(queries, dtype=np.float32)
+        if q.ndim != 2 or q.shape[1] != self.dim:
+            raise ValueError(f"expected (n, {self.dim}) queries, got "
+                             f"{q.shape}")
+        n = q.shape[0]
+        q = pad_rows(q, self.bucket_for(n))
+        # serialized dispatch: see DEVICE_DISPATCH_LOCK in engine.py —
+        # index queries come straight off request threads
+        with DEVICE_DISPATCH_LOCK, jax.transfer_guard("disallow"):
+            qd = jax.device_put(q, self._query_sh)
+            scores, idx = jax.device_get(self._fn(self._corpus, self._valid,
+                                                  qd))
+        self._calls += 1
+        return np.asarray(scores)[:n], np.asarray(idx)[:n]
+
+    # ---- warmup + observability -----------------------------------------
+
+    def warmup(self) -> None:
+        for b in self.query_buckets:
+            self.topk(np.zeros((b, self.dim), np.float32))
+        size = getattr(self._fn, "_cache_size", None)
+        self._baseline_cache = int(size()) if size is not None else None
+
+    def recompiles(self) -> int:
+        if self._baseline_cache is None:
+            return -1
+        size = getattr(self._fn, "_cache_size", None)
+        if size is None:
+            return -1
+        return max(0, int(size()) - self._baseline_cache)
+
+    def stats(self) -> dict:
+        return {"size": self.size, "dim": self.dim, "k": self.k,
+                "query_buckets": list(self.query_buckets),
+                "calls": self._calls, "recompiles": self.recompiles()}

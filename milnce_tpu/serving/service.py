@@ -1,0 +1,316 @@
+"""Serving front: programmatic retrieval API + stdlib threaded HTTP/JSON.
+
+Request flow for a text query (the full tentpole path)::
+
+    sentence --tokenizer--> token row --cache?--> hit: cached embedding
+                                      \\--miss--> DynamicBatcher (pad to
+                                      bucket) --> InferenceEngine.embed_text
+    embedding --> DeviceRetrievalIndex.topk --> (scores, corpus indices)
+
+Everything device-side is pre-traced and transfer-guarded (engine.py /
+index.py); everything host-side is stdlib + numpy.  The HTTP front is
+``http.server.ThreadingHTTPServer`` on purpose: zero new dependencies,
+one thread per connection, and the real concurrency story lives in the
+batcher anyway — handler threads just block on futures.
+
+Endpoints (JSON in/out):
+
+- ``POST /v1/query``       {"token_ids": [[...]] | "sentences": [...],
+                            "k": int?, "timeout_ms": float?}
+                           -> {"results": [{"indices": [...],
+                                            "scores": [...]}, ...]}
+- ``POST /v1/embed_text``  same inputs -> {"embeddings": [[...], ...]}
+- ``GET  /healthz``        resilience-style counters: uptime, request /
+                           error / deadline-expired totals, engine
+                           recompile count, batch-occupancy histogram,
+                           cache hit rate, index size.
+
+Deadline semantics: ``timeout_ms`` bounds a request's QUEUE wait in the
+batcher (ROBUSTNESS.md "Serving request path").  An expired request
+fails with HTTP 504 / :class:`~milnce_tpu.serving.batcher.DeadlineExpired`
+— never a silent drop.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from milnce_tpu.serving.batcher import DeadlineExpired, DynamicBatcher
+from milnce_tpu.serving.cache import EmbeddingLRUCache, token_key
+
+log = logging.getLogger(__name__)
+
+# Safety margin on future waits past the request deadline: covers device
+# execution of an already-submitted batch (a deadline bounds queue wait,
+# not in-flight compute), so a wedged device surfaces as an error instead
+# of a hung handler thread.
+_RESULT_WAIT_SLACK_S = 30.0
+
+
+class RetrievalService:
+    """Programmatic API over engine + batcher + cache + index."""
+
+    def __init__(self, engine, index=None, *, tokenizer=None,
+                 cache: Optional[EmbeddingLRUCache] = None,
+                 max_delay_ms: float = 5.0, default_timeout_ms: float = 0.0):
+        self.engine = engine
+        self.index = index
+        self.tokenizer = tokenizer
+        self.cache = cache if cache is not None else EmbeddingLRUCache(0)
+        self._batcher = DynamicBatcher(
+            engine.embed_text, engine.bucket_for, max_batch=engine.max_batch,
+            max_delay_ms=max_delay_ms, default_timeout_ms=default_timeout_ms,
+            name="text")
+        self._default_timeout_ms = float(default_timeout_ms)
+        self._lock = threading.Lock()
+        self._started = time.time()
+        self._queries = 0
+        self._errors = 0
+
+    # ---- embedding path --------------------------------------------------
+
+    def embed_text_ids(self, token_ids: np.ndarray,
+                       timeout_ms: Optional[float] = None) -> np.ndarray:
+        """(n, W) int32 -> (n, D): cache hits answered on host, misses
+        batched through the engine; results land back in the cache."""
+        rows = np.ascontiguousarray(token_ids, dtype=np.int32)
+        if rows.ndim != 2:
+            raise ValueError(f"expected (n, W) token ids, got {rows.shape}")
+        keys = [token_key(r) for r in rows]
+        out: list[Optional[np.ndarray]] = [self.cache.get(k) for k in keys]
+        pending = [(i, self._batcher.submit(rows[i], timeout_ms))
+                   for i, hit in enumerate(out) if hit is None]
+        wait = self._result_wait_s(timeout_ms)
+        for i, fut in pending:
+            row = fut.result(timeout=wait)
+            self.cache.put(keys[i], row)
+            out[i] = row
+        return np.stack(out) if out else np.zeros(
+            (0, self.engine.embed_dim or 0), np.float32)
+
+    def _result_wait_s(self, timeout_ms: Optional[float]) -> Optional[float]:
+        t_ms = (self._default_timeout_ms if timeout_ms is None
+                else float(timeout_ms))
+        return (t_ms / 1000.0 + _RESULT_WAIT_SLACK_S) if t_ms > 0 else None
+
+    def _encode(self, sentences) -> np.ndarray:
+        if self.tokenizer is None:
+            raise ValueError("service built without a tokenizer — send "
+                             "token_ids instead of sentences")
+        return self.tokenizer.encode_batch(sentences,
+                                           self.engine.text_words)
+
+    # ---- query path ------------------------------------------------------
+
+    def query_ids(self, token_ids: np.ndarray, k: Optional[int] = None,
+                  timeout_ms: Optional[float] = None
+                  ) -> tuple[np.ndarray, np.ndarray]:
+        """(n, W) token ids -> ((n, k) scores, (n, k) corpus indices)."""
+        if self.index is None:
+            raise ValueError("service built without a retrieval index")
+        k = self.index.k if k is None else int(k)
+        if not 1 <= k <= self.index.k:
+            raise ValueError(f"k={k} outside [1, index k={self.index.k}]")
+        with self._lock:
+            self._queries += len(token_ids)
+        try:
+            emb = self.embed_text_ids(token_ids, timeout_ms)
+            scores, idx = self.index.topk(emb)
+        except Exception:
+            with self._lock:
+                self._errors += len(token_ids)
+            raise
+        return scores[:, :k], idx[:, :k]
+
+    def query_sentences(self, sentences, k: Optional[int] = None,
+                        timeout_ms: Optional[float] = None
+                        ) -> tuple[np.ndarray, np.ndarray]:
+        return self.query_ids(self._encode(sentences), k, timeout_ms)
+
+    # ---- lifecycle / observability --------------------------------------
+
+    def health(self) -> dict:
+        with self._lock:
+            queries, errors = self._queries, self._errors
+        return {
+            "status": "ok",
+            "uptime_s": time.time() - self._started,
+            "queries": queries,
+            "query_errors": errors,
+            "engine": self.engine.stats(),
+            "batcher": self._batcher.stats(),
+            "cache": self.cache.stats(),
+            "index": self.index.stats() if self.index is not None else None,
+        }
+
+    def close(self) -> None:
+        self._batcher.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP front
+# ---------------------------------------------------------------------------
+
+class _Handler(BaseHTTPRequestHandler):
+    # set per-server in serve_http
+    service: RetrievalService = None        # type: ignore[assignment]
+
+    def log_message(self, fmt, *args):       # route access logs to logging
+        log.debug("%s " + fmt, self.address_string(), *args)
+
+    def _reply(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:
+        if self.path.rstrip("/") in ("/healthz", "/health"):
+            self._reply(200, self.service.health())
+        else:
+            self._reply(404, {"error": f"no route {self.path!r}"})
+
+    def do_POST(self) -> None:
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length) or b"{}")
+            if self.path == "/v1/query":
+                scores, idx = self._dispatch(self.service.query_ids,
+                                             self.service.query_sentences,
+                                             req)
+                self._reply(200, {"results": [
+                    {"indices": row_i.tolist(), "scores": row_s.tolist()}
+                    for row_s, row_i in zip(scores, idx)]})
+            elif self.path == "/v1/embed_text":
+                rows = self._token_rows(req)
+                emb = self.service.embed_text_ids(
+                    rows, req.get("timeout_ms"))
+                self._reply(200, {"embeddings": emb.tolist()})
+            else:
+                self._reply(404, {"error": f"no route {self.path!r}"})
+        except DeadlineExpired as exc:
+            self._reply(504, {"error": str(exc),
+                              "kind": "deadline_expired"})
+        except (ValueError, KeyError, json.JSONDecodeError) as exc:
+            self._reply(400, {"error": str(exc)})
+        except Exception as exc:
+            log.exception("serving request failed")
+            self._reply(500, {"error": f"{type(exc).__name__}: {exc}"})
+
+    def _token_rows(self, req: dict) -> np.ndarray:
+        if "token_ids" in req:
+            return np.asarray(req["token_ids"], np.int32)
+        return self.service._encode(req["sentences"])
+
+    def _dispatch(self, by_ids, by_sentences, req: dict):
+        k, t = req.get("k"), req.get("timeout_ms")
+        if "token_ids" in req:
+            return by_ids(np.asarray(req["token_ids"], np.int32), k, t)
+        if "sentences" in req:
+            return by_sentences(req["sentences"], k, t)
+        raise ValueError("request needs 'token_ids' or 'sentences'")
+
+
+def serve_http(service: RetrievalService, host: str = "127.0.0.1",
+               port: int = 0) -> ThreadingHTTPServer:
+    """Bind a threaded HTTP server (port 0 = ephemeral, for tests); the
+    caller owns ``serve_forever`` / ``shutdown``."""
+    handler = type("BoundHandler", (_Handler,), {"service": service})
+    server = ThreadingHTTPServer((host, port), handler)
+    server.daemon_threads = True
+    return server
+
+
+def main(argv=None) -> None:
+    """``milnce-serve``: HTTP retrieval service over a frozen export.
+
+    Same CLI grammar as the trainer (``--preset`` + ``--serve.*`` /
+    ``--parallel.*`` overrides — config.py).  The corpus comes from
+    ``--serve.corpus_npz`` (a (N, D) float32 embedding matrix, e.g. an
+    offline eval extraction); without it the service starts embed-only
+    (query requests 400 until an index exists)."""
+    import os
+
+    from milnce_tpu.config import parse_cli
+    from milnce_tpu.data.tokenizer import Tokenizer
+    from milnce_tpu.parallel.mesh import build_mesh, initialize_distributed
+    from milnce_tpu.serving.engine import InferenceEngine
+    from milnce_tpu.serving.export import METADATA_FILE
+    from milnce_tpu.serving.index import DeviceRetrievalIndex
+
+    cfg = parse_cli(argv, description="milnce-tpu serving front")
+    s = cfg.serve
+    if not s.export_dir:
+        raise SystemExit("--serve.export_dir is required (a milnce-export "
+                         "artifact directory)")
+    initialize_distributed(cfg.parallel)
+    mesh = build_mesh(cfg.parallel)
+    engine = InferenceEngine.from_export(s.export_dir, mesh, dtype=s.dtype,
+                                         max_batch=s.max_batch,
+                                         min_bucket=s.min_bucket,
+                                         data_axis=cfg.parallel.data_axis)
+    # sentence requests need a vocab: --serve.token_dict_path wins, else
+    # the path the export recorded; with neither, token_ids-only (400s
+    # on "sentences" explain themselves)
+    with open(os.path.join(s.export_dir, METADATA_FILE)) as fh:
+        meta = json.load(fh)
+    tok_meta = meta.get("tokenizer", {})
+    tokenizer = None
+    if s.token_dict_path:
+        if not os.path.exists(s.token_dict_path):
+            # an explicit operator path must fail loudly at boot — the
+            # export-recorded fallback below is the only silent degrade
+            raise SystemExit(f"--serve.token_dict_path "
+                             f"{s.token_dict_path!r} does not exist")
+        tokenizer = Tokenizer.from_npy(s.token_dict_path,
+                                       max_words=engine.text_words)
+    else:
+        recorded = tok_meta.get("token_dict_path", "")
+        if recorded and os.path.exists(recorded):
+            tokenizer = Tokenizer.from_npy(recorded,
+                                           max_words=engine.text_words)
+    index = None
+    if s.corpus_npz:
+        with np.load(s.corpus_npz) as z:
+            if "emb" in z.files:            # the documented contract
+                corpus = z["emb"]
+            elif len(z.files) == 1:
+                corpus = z[z.files[0]]
+            else:
+                raise SystemExit(
+                    f"--serve.corpus_npz {s.corpus_npz!r} holds "
+                    f"{z.files} — store the corpus under the 'emb' key "
+                    "(np.savez(..., emb=embeddings)) so the index can't "
+                    "silently build over the wrong array")
+        index = DeviceRetrievalIndex(mesh, corpus, k=s.topk,
+                                     query_buckets=engine.buckets,
+                                     data_axis=cfg.parallel.data_axis)
+    service = RetrievalService(
+        engine, index, tokenizer=tokenizer,
+        cache=EmbeddingLRUCache(s.cache_capacity),
+        max_delay_ms=s.max_delay_ms, default_timeout_ms=s.default_timeout_ms)
+    server = serve_http(service, s.host, s.port)
+    # flush: operators poll a redirected log for this readiness line
+    print(f"milnce-serve: listening on http://{s.host}:"
+          f"{server.server_address[1]} (buckets {engine.buckets}, "
+          f"index={'none' if index is None else index.size}, "
+          f"tokenizer={'yes' if tokenizer else 'token_ids-only'})",
+          flush=True)
+    try:
+        server.serve_forever()
+    finally:
+        server.server_close()
+        service.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,310 @@
+#!/usr/bin/env python
+"""Serving load generator: open/closed-loop driver over the full
+batcher -> engine -> index path, emitting a ``SERVE_BENCH_*.json``
+report (latency percentiles, QPS, batch-occupancy histogram, cache hit
+rate).
+
+Usage::
+
+    python scripts/serve_bench.py --backend cpu --preset tiny      # smoke
+    python scripts/serve_bench.py --preset tiny --mode open --qps 200
+    python scripts/serve_bench.py --export_dir export/run1 ...     # real params
+
+Modes:
+
+- **closed** (default): ``--concurrency`` workers each issue the next
+  query the moment the previous one completes — measures the service's
+  self-paced throughput and the latency it costs.
+- **open**: queries arrive on a Poisson clock at ``--qps`` regardless of
+  completions (the honest SLO view: latency under an offered load that
+  does not politely wait for the server).
+
+Queries are drawn from a ``--distinct``-sized pool with a Zipf-ish
+(1/rank) distribution, so the text-embedding cache sees a realistic
+heavy-tailed hit pattern; ``--distinct 0`` disables reuse (pure-miss).
+
+Timing honesty: every recorded latency spans submit -> numpy result on
+host (the service API materializes results), so there is no async-
+dispatch mirage to correct for; the engine warmup (compiles) happens
+before the measurement window and is reported separately as
+``warmup_s``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def build_service(args):
+    """Tiny-preset service stack: random frozen params (or an export),
+    synthetic video corpus, programmatic API only."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from milnce_tpu.config import PRESETS
+    from milnce_tpu.models.build import build_model
+    from milnce_tpu.parallel.mesh import build_mesh
+    from milnce_tpu.serving.cache import EmbeddingLRUCache
+    from milnce_tpu.serving.engine import InferenceEngine
+    from milnce_tpu.serving.index import DeviceRetrievalIndex
+    from milnce_tpu.serving.service import RetrievalService
+
+    cfg = PRESETS[args.preset]()
+    mesh = build_mesh(cfg.parallel)
+    video_shape = (cfg.data.num_frames, cfg.data.video_size,
+                   cfg.data.video_size, 3)
+    if args.export_dir:
+        engine = InferenceEngine.from_export(args.export_dir, mesh,
+                                             max_batch=args.max_batch)
+    else:
+        model = build_model(cfg.model)
+        variables = model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1,) + video_shape, jnp.float32),
+            jnp.zeros((1, cfg.data.max_words), jnp.int32))
+        engine = InferenceEngine(
+            model, {"params": variables["params"],
+                    "batch_stats": variables.get("batch_stats", {})},
+            mesh, text_words=cfg.data.max_words, video_shape=video_shape,
+            max_batch=args.max_batch)
+
+    # synthetic corpus, embedded through the engine in bucket-sized chunks
+    rng = np.random.default_rng(0)
+    corpus_emb = []
+    top = engine.buckets[-1]
+    for lo in range(0, args.corpus, top):
+        n = min(top, args.corpus - lo)
+        clips = rng.integers(0, 255, (n,) + video_shape, dtype=np.uint8)
+        corpus_emb.append(engine.embed_video(clips))
+    index = DeviceRetrievalIndex(
+        mesh, np.concatenate(corpus_emb, axis=0),
+        k=min(args.topk, args.corpus), query_buckets=engine.buckets)
+    service = RetrievalService(
+        engine, index, cache=EmbeddingLRUCache(args.cache_capacity),
+        max_delay_ms=args.max_delay_ms,
+        default_timeout_ms=args.timeout_ms)
+    return cfg, service
+
+
+def make_query_draw(cfg, distinct: int):
+    """-> ``draw(rng) -> (W,) int32 token row``.
+
+    ``distinct > 0``: rows come from a fixed pool with 1/rank (Zipf-ish)
+    weights — the heavy-tailed repeat pattern the cache exists for.
+    ``distinct <= 0``: every draw is a FRESH random row (pure-miss mode;
+    the cache never helps)."""
+    import numpy as np
+
+    vocab, words = cfg.model.vocab_size, cfg.data.max_words
+    if distinct <= 0:
+        def draw(rng):
+            return rng.integers(1, vocab, (words,)).astype(np.int32)
+
+        return draw
+    pool_rng = np.random.default_rng(7)
+    pool = pool_rng.integers(1, vocab, (distinct, words)).astype(np.int32)
+    ranks = np.arange(1, len(pool) + 1, dtype=np.float64)
+    probs = (1.0 / ranks) / (1.0 / ranks).sum()
+
+    def draw(rng):
+        return pool[rng.choice(len(pool), p=probs)]
+
+    return draw
+
+
+def run_closed_loop(service, draw, duration: float,
+                    concurrency: int):
+    """Each worker issues the next query on completion; returns
+    (latencies_s, errors, expired)."""
+    import numpy as np
+
+    from milnce_tpu.serving.batcher import DeadlineExpired
+
+    lats: list[float] = []
+    errors = [0]
+    expired = [0]
+    lock = threading.Lock()
+    t_end = time.monotonic() + duration
+
+    def worker(wid: int):
+        rng = np.random.default_rng(1000 + wid)
+        while time.monotonic() < t_end:
+            row = draw(rng)
+            t0 = time.perf_counter()
+            try:
+                service.query_ids(row[None, :])
+            except DeadlineExpired:
+                with lock:
+                    expired[0] += 1
+                continue
+            except Exception:
+                with lock:
+                    errors[0] += 1
+                continue
+            dt = time.perf_counter() - t0
+            with lock:
+                lats.append(dt)
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return lats, errors[0], expired[0]
+
+
+def run_open_loop(service, draw, duration: float, qps: float):
+    """Poisson arrivals at ``qps``; each arrival runs on its own thread
+    (requests keep arriving whether or not earlier ones finished)."""
+    import numpy as np
+
+    from milnce_tpu.serving.batcher import DeadlineExpired
+
+    lats: list[float] = []
+    errors = [0]
+    expired = [0]
+    lock = threading.Lock()
+    rng = np.random.default_rng(11)
+    inflight: list[threading.Thread] = []
+
+    def one(row):
+        t0 = time.perf_counter()
+        try:
+            service.query_ids(row[None, :])
+        except DeadlineExpired:
+            with lock:
+                expired[0] += 1
+            return
+        except Exception:
+            with lock:
+                errors[0] += 1
+            return
+        dt = time.perf_counter() - t0
+        with lock:
+            lats.append(dt)
+
+    t_end = time.monotonic() + duration
+    next_arrival = time.monotonic()
+    while time.monotonic() < t_end:
+        now = time.monotonic()
+        if now < next_arrival:
+            time.sleep(min(next_arrival - now, 0.01))
+            continue
+        next_arrival += rng.exponential(1.0 / qps)
+        row = draw(rng)
+        t = threading.Thread(target=one, args=(row,), daemon=True)
+        t.start()
+        inflight.append(t)
+    for t in inflight:
+        t.join(timeout=30.0)
+    return lats, errors[0], expired[0]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="serving load generator (scripts/serve_bench.py)")
+    ap.add_argument("--backend", choices=("cpu", "default"), default="cpu",
+                    help="'cpu' pins JAX_PLATFORMS=cpu (hermetic smoke); "
+                         "'default' uses whatever accelerator jax finds")
+    ap.add_argument("--preset", choices=("tiny", "small", "full"),
+                    default="tiny")
+    ap.add_argument("--mode", choices=("closed", "open"), default="closed")
+    ap.add_argument("--duration", type=float, default=3.0,
+                    help="measurement window seconds")
+    ap.add_argument("--concurrency", type=int, default=4,
+                    help="closed-loop workers")
+    ap.add_argument("--qps", type=float, default=100.0,
+                    help="open-loop offered load")
+    ap.add_argument("--corpus", type=int, default=64,
+                    help="synthetic video corpus size")
+    ap.add_argument("--distinct", type=int, default=32,
+                    help="distinct query pool, Zipf-weighted (repeats hit "
+                         "the cache); 0 = fresh random row per request "
+                         "(pure-miss)")
+    ap.add_argument("--topk", type=int, default=5)
+    ap.add_argument("--max_batch", type=int, default=16,
+                    help="top bucket (taller ladders compile longer)")
+    ap.add_argument("--max_delay_ms", type=float, default=3.0)
+    ap.add_argument("--timeout_ms", type=float, default=0.0)
+    ap.add_argument("--cache_capacity", type=int, default=4096)
+    ap.add_argument("--export_dir", default="",
+                    help="serve a milnce-export instead of random params")
+    ap.add_argument("--out", default="",
+                    help="report path (default "
+                         "SERVE_BENCH_<preset>_<mode>.json at repo root)")
+    args = ap.parse_args(argv)
+
+    if args.backend == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+
+    t0 = time.monotonic()
+    cfg, service = build_service(args)     # includes engine+index warmup
+    warmup_s = time.monotonic() - t0
+    draw = make_query_draw(cfg, args.distinct)
+
+    t_run = time.monotonic()
+    if args.mode == "closed":
+        lats, errors, expired = run_closed_loop(
+            service, draw, args.duration, args.concurrency)
+    else:
+        lats, errors, expired = run_open_loop(
+            service, draw, args.duration, args.qps)
+    elapsed = time.monotonic() - t_run
+    health = service.health()
+    service.close()
+
+    lat_ms = np.asarray(sorted(lats), np.float64) * 1e3
+    pct = (lambda q: float(np.percentile(lat_ms, q))) if len(lat_ms) else (
+        lambda q: float("nan"))
+    report = {
+        "generator": "scripts/serve_bench.py",
+        "mode": args.mode,
+        "backend": args.backend,
+        "preset": args.preset,
+        "config": {k: v for k, v in vars(args).items() if k != "out"},
+        "warmup_s": round(warmup_s, 3),
+        "elapsed_s": round(elapsed, 3),
+        "requests": len(lats),
+        "errors": errors,
+        "deadline_expired": expired,
+        "qps": round(len(lats) / elapsed, 2) if elapsed > 0 else 0.0,
+        "latency_ms": {
+            "p50": round(pct(50), 3), "p95": round(pct(95), 3),
+            "p99": round(pct(99), 3),
+            "mean": round(float(lat_ms.mean()), 3) if len(lat_ms) else
+            float("nan"),
+            "max": round(float(lat_ms.max()), 3) if len(lat_ms) else
+            float("nan"),
+        },
+        "batch_occupancy": health["batcher"]["occupancy"],
+        "batcher": {k: v for k, v in health["batcher"].items()
+                    if k != "occupancy"},
+        "cache": health["cache"],
+        "engine": health["engine"],
+        "index": health["index"],
+    }
+    out = args.out or os.path.join(
+        _REPO, f"SERVE_BENCH_{args.preset}_{args.mode}.json")
+    with open(out, "w") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True)
+    print(f"serve_bench: {report['requests']} requests in {elapsed:.2f}s "
+          f"({report['qps']} QPS), p50={report['latency_ms']['p50']}ms "
+          f"p99={report['latency_ms']['p99']}ms, cache hit rate "
+          f"{report['cache']['hit_rate']:.2f}, "
+          f"recompiles={report['engine']['recompiles']} -> {out}")
+    return 0 if report["engine"]["recompiles"] in (0, -1) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

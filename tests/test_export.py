@@ -1,0 +1,144 @@
+"""Params-only frozen export (ISSUE 4 satellite): training checkpoint ->
+``milnce-export`` artifact -> serving loader round-trip, exactly."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def train_ckpt(tmp_path_factory):
+    """A saved tiny training checkpoint + the state that produced it."""
+    import jax
+    import jax.numpy as jnp
+
+    from milnce_tpu.config import ModelConfig, OptimConfig
+    from milnce_tpu.models.build import build_model
+    from milnce_tpu.train.checkpoint import CheckpointManager
+    from milnce_tpu.train.schedule import build_schedule
+    from milnce_tpu.train.state import build_optimizer, create_train_state
+
+    mcfg = ModelConfig(embedding_dim=16, vocab_size=128,
+                       word_embedding_dim=8, text_hidden_dim=16,
+                       inception_blocks=1)
+    model = build_model(mcfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4, 32, 32, 3)),
+                           jnp.zeros((1, 6), jnp.int32))
+    opt_cfg = OptimConfig(warmup_steps=2)
+    opt = build_optimizer(opt_cfg, build_schedule(opt_cfg, 10))
+    state = create_train_state(dict(variables), opt)
+    ckpt_dir = str(tmp_path_factory.mktemp("run"))
+    mgr = CheckpointManager(ckpt_dir, keep=2)
+    mgr.save(0, state)
+    mgr.wait()
+    mgr.close()
+    return dict(ckpt_dir=ckpt_dir, state=state, model_cfg=mcfg)
+
+
+_CLI_MODEL_FLAGS = ["--model.embedding_dim", "16",
+                    "--model.vocab_size", "128",
+                    "--model.word_embedding_dim", "8",
+                    "--model.text_hidden_dim", "16",
+                    "--model.inception_blocks", "1",
+                    "--data.max_words", "6"]
+
+
+@pytest.fixture(scope="module")
+def export_dir(train_ckpt, tmp_path_factory):
+    from milnce_tpu.serving.export import main as export_main
+
+    out = str(tmp_path_factory.mktemp("export"))
+    export_main(["--checkpoint_dir", train_ckpt["ckpt_dir"], "--out", out,
+                 "--preset", "tiny"] + _CLI_MODEL_FLAGS)
+    return out
+
+
+def test_round_trip_is_exact(train_ckpt, export_dir):
+    """Every params + batch_stats leaf survives checkpoint -> export ->
+    load bit-exactly (same tree paths, same values)."""
+    import jax
+
+    from milnce_tpu.serving.export import load_inference_checkpoint
+
+    _meta, loaded = load_inference_checkpoint(export_dir)
+    state = train_ckpt["state"]
+    for name, orig, back in (("params", state.params, loaded["params"]),
+                             ("batch_stats", state.batch_stats,
+                              loaded["batch_stats"])):
+        a = jax.tree_util.tree_leaves_with_path(orig)
+        b = dict(jax.tree_util.tree_leaves_with_path(back))
+        assert len(a) == len(b), name
+        for path, leaf in a:
+            assert np.array_equal(np.asarray(leaf), b[path]), (name, path)
+
+
+def test_metadata_contract(export_dir):
+    from milnce_tpu.serving.export import METADATA_FILE
+
+    meta = json.load(open(os.path.join(export_dir, METADATA_FILE)))
+    assert meta["format_version"] == 1
+    assert "milnce_tpu/serving/export.py" in meta["generator"]
+    assert meta["video_shape"] == [4, 32, 32, 3]        # tiny preset
+    assert meta["tokenizer"]["max_words"] == 6
+    assert meta["model"]["embedding_dim"] == 16
+    assert meta["model"]["word2vec_path"] == ""         # sanitized
+    assert meta["step"] == 0 and meta["param_bytes"] > 0
+
+
+def test_no_optimizer_state_ships(export_dir):
+    """The artifact is params-only: no Adam moments, and it is SMALLER
+    than the float bytes of params+stats+2x-moments would be."""
+    from milnce_tpu.serving.export import ARRAYS_FILE
+
+    with np.load(os.path.join(export_dir, ARRAYS_FILE)) as z:
+        keys = list(z.files)
+    assert all(k.startswith(("params/", "batch_stats/")) for k in keys)
+    assert not any("opt" in k for k in keys)
+
+
+def test_engine_boots_from_export_and_serves(export_dir):
+    import jax
+    from jax.sharding import Mesh
+
+    from milnce_tpu.serving.engine import InferenceEngine
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    engine = InferenceEngine.from_export(export_dir, mesh, max_batch=8)
+    out = engine.embed_text(np.ones((2, 6), np.int32))
+    assert out.shape == (2, 16) and np.isfinite(out).all()
+    assert engine.recompiles() == 0
+
+
+def test_bf16_cast_is_a_load_time_decision(export_dir):
+    """One f32 artifact serves both precisions: dtype='bfloat16' casts
+    params at load and the engine emits bf16 embeddings."""
+    import jax
+    from jax.sharding import Mesh
+
+    from milnce_tpu.serving.engine import InferenceEngine
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    engine = InferenceEngine.from_export(export_dir, mesh, max_batch=8,
+                                         dtype="bfloat16")
+    out = engine.embed_text(np.ones((2, 6), np.int32))
+    assert str(out.dtype) == "bfloat16" and np.isfinite(
+        out.astype(np.float32)).all()
+
+
+def test_format_version_gate(export_dir, tmp_path):
+    import shutil
+
+    from milnce_tpu.serving.export import (METADATA_FILE,
+                                           load_inference_checkpoint)
+
+    bad = tmp_path / "bad_export"
+    shutil.copytree(export_dir, bad)
+    meta_path = bad / METADATA_FILE
+    meta = json.load(open(meta_path))
+    meta["format_version"] = 999
+    json.dump(meta, open(meta_path, "w"))
+    with pytest.raises(ValueError, match="format"):
+        load_inference_checkpoint(str(bad))

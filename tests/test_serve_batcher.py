@@ -1,0 +1,188 @@
+"""Dynamic micro-batcher edge semantics (ISSUE 4 satellite): flush on
+size and on delay, pad/unpad identity, bucket selection at boundaries,
+deadline-expired -> error (never a silent drop), batch-failure
+propagation.  jax-free by construction — the batcher is numpy-only and
+these tests pin that boundary too (a fake run_batch stands in for the
+engine)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from milnce_tpu.serving.batcher import DeadlineExpired, DynamicBatcher
+
+_BUCKETS = (4, 8)
+
+
+def _bucket_for(n: int) -> int:
+    for b in _BUCKETS:
+        if n <= b:
+            return b
+    raise ValueError(n)
+
+
+class _FakeEngine:
+    """Records every padded batch; result row = payload * 2 (so per-row
+    identity is checkable through pad/unpad)."""
+
+    def __init__(self, fail=False, delay_s=0.0):
+        self.batches: list[np.ndarray] = []
+        self.fail = fail
+        self.delay_s = delay_s
+        self._lock = threading.Lock()
+
+    def __call__(self, rows: np.ndarray) -> np.ndarray:
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if self.fail:
+            raise ValueError("injected batch failure")
+        with self._lock:
+            self.batches.append(np.array(rows, copy=True))
+        return rows * 2.0
+
+
+def _mk(engine, **kw):
+    kw.setdefault("max_batch", _BUCKETS[-1])
+    return DynamicBatcher(engine, _bucket_for, **kw)
+
+
+def _rows(n, w=3):
+    return [np.full((w,), float(i), np.float32) for i in range(n)]
+
+
+def test_flush_on_max_batch_does_not_wait_for_delay():
+    eng = _FakeEngine()
+    b = _mk(eng, max_batch=4, max_delay_ms=10_000)   # delay flush never fires
+    t0 = time.monotonic()
+    futs = [b.submit(r) for r in _rows(4)]
+    out = [f.result(timeout=5) for f in futs]
+    assert time.monotonic() - t0 < 5.0               # well under the 10s delay
+    assert len(eng.batches) == 1 and eng.batches[0].shape == (4, 3)
+    for i, row in enumerate(out):
+        assert np.array_equal(row, np.full((3,), 2.0 * i))
+    occ = b.stats()["occupancy"]["4"]
+    assert occ == {"flushes": 1, "rows": 4, "mean_fill": 1.0}
+    b.close()
+
+
+def test_flush_on_delay_serves_a_lone_request():
+    eng = _FakeEngine()
+    b = _mk(eng, max_delay_ms=40)
+    t0 = time.monotonic()
+    row = b.submit(np.ones((3,), np.float32)).result(timeout=5)
+    waited = time.monotonic() - t0
+    assert np.array_equal(row, np.full((3,), 2.0))
+    assert waited >= 0.03                 # did wait for company...
+    assert eng.batches[0].shape == (4, 3)  # ...then padded to the floor bucket
+    b.close()
+
+
+def test_pad_unpad_identity_matches_per_sample_results():
+    eng = _FakeEngine()
+    b = _mk(eng, max_delay_ms=30)
+    futs = [b.submit(r) for r in _rows(3)]
+    batched = np.stack([f.result(timeout=5) for f in futs])
+    assert np.array_equal(batched, np.stack(_rows(3)) * 2.0)
+    # the engine really saw ONE padded bucket, zeros in the pad slots
+    (batch,) = eng.batches
+    assert batch.shape == (4, 3)
+    assert np.array_equal(batch[3], np.zeros((3,)))
+    b.close()
+
+
+@pytest.mark.parametrize("n,bucket", [(1, 4), (4, 4), (5, 8), (8, 8)])
+def test_bucket_selection_at_boundaries(n, bucket):
+    eng = _FakeEngine()
+    b = _mk(eng, max_delay_ms=150)        # plenty to collect all n submits
+    futs = [b.submit(r) for r in _rows(n)]
+    for f in futs:
+        f.result(timeout=5)
+    assert len(eng.batches) == 1, "expected one flush for the burst"
+    assert eng.batches[0].shape == (bucket, 3)
+    b.close()
+
+
+def test_expired_deadline_is_an_error_not_a_silent_drop():
+    eng = _FakeEngine()
+    b = _mk(eng, max_delay_ms=10_000)     # only the deadline can end the wait
+    fut = b.submit(np.ones((3,), np.float32), timeout_ms=40)
+    with pytest.raises(DeadlineExpired):
+        fut.result(timeout=5)             # resolves promptly, NOT after 10s
+    assert b.stats()["deadline_expired"] == 1
+    assert eng.batches == []              # never reached the engine
+    b.close()
+
+
+def test_live_requests_survive_a_neighbors_expiry():
+    eng = _FakeEngine()
+    b = _mk(eng, max_delay_ms=10_000)
+    doomed = b.submit(np.zeros((3,), np.float32), timeout_ms=40)
+    alive = b.submit(np.ones((3,), np.float32))     # no deadline
+    with pytest.raises(DeadlineExpired):
+        doomed.result(timeout=5)
+    assert np.array_equal(alive.result(timeout=5), np.full((3,), 2.0))
+    b.close()
+
+
+def test_mixed_shape_batch_fails_the_batch_not_the_worker():
+    """A malformed payload mix (np.stack of unequal row shapes raises
+    BEFORE run_batch) must fail that batch's futures and leave the
+    worker alive — a dead worker would strand every later request."""
+    eng = _FakeEngine()
+    b = _mk(eng, max_delay_ms=60)
+    f1 = b.submit(np.ones((3,), np.float32))
+    f2 = b.submit(np.ones((4,), np.float32))      # width mismatch
+    for f in (f1, f2):
+        with pytest.raises(ValueError):
+            f.result(timeout=5)
+    assert b.stats()["batch_errors"] == 1
+    # the worker survived: a well-formed request still gets served
+    ok = b.submit(np.ones((3,), np.float32)).result(timeout=5)
+    assert np.array_equal(ok, np.full((3,), 2.0))
+    b.close()
+
+
+def test_batch_failure_propagates_to_every_caller():
+    b = _mk(_FakeEngine(fail=True), max_delay_ms=20)
+    futs = [b.submit(r) for r in _rows(2)]
+    for f in futs:
+        with pytest.raises(ValueError, match="injected batch failure"):
+            f.result(timeout=5)
+    assert b.stats()["batch_errors"] == 1
+    b.close()
+
+
+def test_default_timeout_applies_when_submit_passes_none():
+    b = _mk(_FakeEngine(), max_delay_ms=10_000, default_timeout_ms=40)
+    with pytest.raises(DeadlineExpired):
+        b.submit(np.ones((3,), np.float32)).result(timeout=5)
+    b.close()
+
+
+def test_explicit_zero_timeout_disables_the_default_deadline():
+    # default deadline (20ms) < delay flush (60ms): a request that kept
+    # the default would expire; timeout_ms=0 opts out and gets served
+    b = _mk(_FakeEngine(), max_delay_ms=60, default_timeout_ms=20)
+    fut = b.submit(np.ones((3,), np.float32), timeout_ms=0)
+    assert np.array_equal(fut.result(timeout=5), np.full((3,), 2.0))
+    b.close()
+
+
+def test_submit_after_close_raises():
+    b = _mk(_FakeEngine())
+    b.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        b.submit(np.ones((3,), np.float32))
+
+
+def test_stats_shape():
+    eng = _FakeEngine()
+    b = _mk(eng, max_delay_ms=20)
+    b.submit(np.ones((3,), np.float32)).result(timeout=5)
+    s = b.stats()
+    assert s["requests"] == 1 and s["flushes"] == 1
+    assert s["deadline_expired"] == 0 and s["batch_errors"] == 0
+    assert s["occupancy"]["4"]["mean_fill"] == pytest.approx(0.25)
+    b.close()

@@ -1,0 +1,51 @@
+"""serve_bench CPU smoke (ISSUE 4 acceptance): the load generator must
+complete on CPU with the tiny preset and emit a valid SERVE_BENCH_*.json
+— latency percentiles, QPS, batch-occupancy histogram, cache hit rate —
+with zero steady-state recompiles.
+
+This intentionally runs the real script as a child process (the report
+format IS the contract), but at a seconds-scale tiny configuration —
+it is tier-1 by design (suite-hygiene exemption documents this)."""
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# path kept in a module constant: the hygiene marker-audit scans test
+# function BODIES for measurement-stack fragments; the explicit
+# exemption in test_suite_hygiene.py is the authoritative carve-out
+_SERVE_BENCH = os.path.join(_REPO, "scripts", "serve_bench.py")
+
+
+def test_cpu_smoke_emits_valid_report(tmp_path):
+    out = tmp_path / "SERVE_BENCH_smoke.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, _SERVE_BENCH, "--backend", "cpu",
+         "--preset", "tiny", "--duration", "1.0", "--concurrency", "2",
+         "--corpus", "12", "--distinct", "6", "--max_batch", "8",
+         "--out", str(out)],
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    report = json.loads(out.read_text())
+
+    assert report["generator"] == "scripts/serve_bench.py"
+    assert report["requests"] > 0 and report["qps"] > 0
+    assert report["errors"] == 0 and report["deadline_expired"] == 0
+    # latency percentiles present, ordered, finite
+    lat = report["latency_ms"]
+    assert lat["p50"] <= lat["p95"] <= lat["p99"] <= lat["max"]
+    assert all(v > 0 for v in (lat["p50"], lat["p95"], lat["p99"]))
+    # batch-occupancy histogram: per-bucket flush counts + fill
+    assert report["batch_occupancy"], "no occupancy recorded"
+    for bucket, ent in report["batch_occupancy"].items():
+        assert int(bucket) >= 1
+        assert ent["flushes"] >= 1 and 0.0 < ent["mean_fill"] <= 1.0
+    # cache saw repeats (distinct pool << requests)
+    assert 0.0 <= report["cache"]["hit_rate"] <= 1.0
+    assert report["cache"]["hits"] + report["cache"]["misses"] > 0
+    # steady state stayed pre-traced
+    assert report["engine"]["recompiles"] in (0, -1)
+    assert report["index"]["size"] == 12

@@ -1,0 +1,349 @@
+"""Serving subsystem gates (ISSUE 4): bucketed engine, device-resident
+index, cache, service, HTTP front — and the served-vs-offline parity
+pin: top-k through the full batcher -> engine -> index path must equal
+the offline eval/retrieval.py ranking exactly.
+
+Everything runs on the hermetic 8-virtual-CPU mesh (conftest.py); one
+module-scoped stack keeps the compile bill to one warmup sweep."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+_FRAMES, _SIZE, _WORDS = 4, 32, 6
+_CORPUS = 21
+
+
+@pytest.fixture(scope="module")
+def stack():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from milnce_tpu.data.tokenizer import Tokenizer, synthetic_vocab
+    from milnce_tpu.models import S3D
+    from milnce_tpu.serving.cache import EmbeddingLRUCache
+    from milnce_tpu.serving.engine import InferenceEngine
+    from milnce_tpu.serving.index import DeviceRetrievalIndex
+    from milnce_tpu.serving.service import RetrievalService
+
+    model = S3D(num_classes=16, vocab_size=64, word_embedding_dim=8,
+                text_hidden_dim=16, inception_blocks=1)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, _FRAMES, _SIZE, _SIZE, 3)),
+                           jnp.zeros((1, _WORDS), jnp.int32))
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    engine = InferenceEngine(model, dict(variables), mesh,
+                             text_words=_WORDS,
+                             video_shape=(_FRAMES, _SIZE, _SIZE, 3),
+                             max_batch=16)
+    rng = np.random.default_rng(0)
+    clips = rng.integers(0, 255, (_CORPUS, _FRAMES, _SIZE, _SIZE, 3),
+                         dtype=np.uint8)
+    corpus_emb = np.concatenate(
+        [engine.embed_video(clips[:16]), engine.embed_video(clips[16:])])
+    index = DeviceRetrievalIndex(mesh, corpus_emb, k=5,
+                                 query_buckets=engine.buckets)
+    service = RetrievalService(
+        engine, index, tokenizer=Tokenizer(synthetic_vocab(63), _WORDS),
+        cache=EmbeddingLRUCache(128), max_delay_ms=3.0)
+    yield dict(model=model, variables=variables, mesh=mesh, engine=engine,
+               clips=clips, corpus_emb=corpus_emb, index=index,
+               service=service)
+    service.close()
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+class TestEngine:
+    def test_bucket_ladder_on_the_test_mesh(self, stack):
+        # 8 virtual devices -> ladder starts at the mesh size
+        assert stack["engine"].buckets == (8, 16)
+
+    @pytest.mark.parametrize("n,bucket", [(1, 8), (8, 8), (9, 16), (16, 16)])
+    def test_bucket_for_boundaries(self, stack, n, bucket):
+        assert stack["engine"].bucket_for(n) == bucket
+
+    def test_oversize_batch_rejected(self, stack):
+        with pytest.raises(ValueError, match="max_batch"):
+            stack["engine"].bucket_for(17)
+
+    def test_wrong_trailing_shape_rejected(self, stack):
+        eng = stack["engine"]
+        with pytest.raises(ValueError, match="token ids"):
+            eng.embed_text(np.zeros((2, _WORDS + 1), np.int32))
+        with pytest.raises(ValueError, match="uint8 video"):
+            eng.embed_video(np.zeros((2, _FRAMES, _SIZE, 16, 3), np.uint8))
+
+    def test_pad_unpad_identity(self, stack):
+        """Rows of a padded partial batch == the same rows embedded in a
+        full bucket: padding slots never leak into real rows."""
+        eng = stack["engine"]
+        rng = np.random.default_rng(1)
+        ids = rng.integers(1, 64, (5, _WORDS)).astype(np.int32)
+        five = eng.embed_text(ids)                     # pads 5 -> 8
+        singles = np.stack([eng.embed_text(ids[i:i + 1])[0]  # pads 1 -> 8
+                            for i in range(5)])
+        np.testing.assert_allclose(five, singles, rtol=1e-5, atol=1e-6)
+
+    def test_ladder_sweep_causes_zero_recompiles(self, stack):
+        eng = stack["engine"]
+        rng = np.random.default_rng(2)
+        for n in (1, 3, 8, 11, 16):
+            eng.embed_text(rng.integers(1, 64, (n, _WORDS)).astype(np.int32))
+            eng.embed_video(rng.integers(
+                0, 255, (n, _FRAMES, _SIZE, _SIZE, 3), dtype=np.uint8))
+        assert eng.recompiles() == 0
+
+
+# ---------------------------------------------------------------------------
+# index
+# ---------------------------------------------------------------------------
+
+class TestIndex:
+    def test_topk_matches_numpy_ranking(self, stack):
+        index, corpus_emb = stack["index"], stack["corpus_emb"]
+        rng = np.random.default_rng(3)
+        q = rng.standard_normal((5, corpus_emb.shape[1])).astype(np.float32)
+        scores, idx = index.topk(q)
+        ref = np.argsort(-(q @ corpus_emb.T), axis=1)[:, :index.k]
+        assert np.array_equal(idx, ref)
+        np.testing.assert_allclose(
+            scores, np.take_along_axis(q @ corpus_emb.T, ref, axis=1),
+            rtol=1e-5, atol=1e-5)
+
+    def test_pad_rows_never_retrieved(self, stack):
+        # every returned index addresses a REAL corpus row (pads are -inf)
+        index = stack["index"]
+        rng = np.random.default_rng(4)
+        q = rng.standard_normal((3, index.dim)).astype(np.float32)
+        _, idx = index.topk(q)
+        assert idx.max() < index.size
+
+    def test_query_bucket_overflow_rejected(self, stack):
+        index = stack["index"]
+        with pytest.raises(ValueError, match="query bucket"):
+            index.topk(np.zeros((17, index.dim), np.float32))
+
+    def test_k_bounds_validated(self, stack):
+        from milnce_tpu.serving.index import DeviceRetrievalIndex
+
+        with pytest.raises(ValueError, match="outside"):
+            DeviceRetrievalIndex(stack["mesh"], stack["corpus_emb"],
+                                 k=_CORPUS + 1, precompile=False)
+
+    def test_geometry_follows_data_axis_on_a_model_parallel_mesh(self,
+                                                                 stack):
+        """On a (data, model) mesh, rows shard over DATA only (P(data)
+        replicates over model) — geometry sized by the total device
+        count would mask most of every shard's corpus to -inf and
+        silently drop it from retrieval."""
+        import jax
+        from jax.sharding import Mesh
+
+        from milnce_tpu.serving.index import DeviceRetrievalIndex
+
+        mesh2d = Mesh(np.array(jax.devices()).reshape(4, 2),
+                      ("data", "model"))
+        corpus_emb = stack["corpus_emb"]
+        index = DeviceRetrievalIndex(mesh2d, corpus_emb, k=5,
+                                     query_buckets=(4,))
+        rng = np.random.default_rng(6)
+        q = rng.standard_normal((4, corpus_emb.shape[1])).astype(np.float32)
+        _, idx = index.topk(q)
+        ref = np.argsort(-(q @ corpus_emb.T), axis=1)[:, :5]
+        assert np.array_equal(idx, ref)
+
+    def test_engine_bucket_ladder_follows_data_axis(self, stack):
+        import jax
+        from jax.sharding import Mesh
+
+        from milnce_tpu.serving.engine import InferenceEngine
+
+        mesh2d = Mesh(np.array(jax.devices()).reshape(4, 2),
+                      ("data", "model"))
+        eng = InferenceEngine(
+            stack["model"], dict(stack["variables"]), mesh2d,
+            text_words=_WORDS, video_shape=(_FRAMES, _SIZE, _SIZE, 3),
+            max_batch=16, precompile=False)
+        assert eng.buckets == (4, 8, 16)   # data extent 4, not 8 devices
+
+
+# ---------------------------------------------------------------------------
+# service (cache + batcher + engine + index) and the parity pin
+# ---------------------------------------------------------------------------
+
+class TestService:
+    def test_served_topk_equals_offline_eval_ranking(self, stack):
+        """ISSUE 4 acceptance: a synthetic corpus queried through the
+        FULL serve path (token rows -> dynamic batcher -> bucketed
+        engine -> sharded device index) ranks exactly as the offline
+        eval/retrieval.py extraction + argsort."""
+        from milnce_tpu.eval.retrieval import extract_retrieval_embeddings
+
+        clips, service = stack["clips"], stack["service"]
+        rng = np.random.default_rng(5)
+        texts = rng.integers(1, 64, (_CORPUS, _WORDS)).astype(np.int32)
+
+        class _Source:
+            def __len__(self):
+                return _CORPUS
+
+            def sample(self, i, rng=None):
+                return {"video": clips[i:i + 1], "text": texts[i:i + 1]}
+
+        t_emb, v_emb = extract_retrieval_embeddings(
+            stack["model"], dict(stack["variables"]), _Source(),
+            stack["mesh"], batch_size=8)
+        offline = np.argsort(-(t_emb @ v_emb.T), axis=1)[:, :5]
+
+        # serve the same corpus: many threads, one row each, so the
+        # batcher actually batches (not one pre-formed request)
+        results = [None] * _CORPUS
+
+        def one(i):
+            _, idx = service.query_ids(texts[i:i + 1])
+            results[i] = idx[0]
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(_CORPUS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        served = np.stack(results)
+        assert np.array_equal(served, offline), (
+            "served top-k diverged from the offline eval ranking")
+        # the batcher actually coalesced: fewer flushes than requests
+        flushes = service.health()["batcher"]["flushes"]
+        assert flushes < _CORPUS
+
+    def test_cache_hits_skip_the_device(self, stack):
+        service = stack["service"]
+        ids = np.full((1, _WORDS), 7, np.int32)
+        service.embed_text_ids(ids)
+        calls_before = dict(service.engine.stats()["calls"])
+        before_hits = service.cache.stats()["hits"]
+        out = service.embed_text_ids(ids)
+        assert service.cache.stats()["hits"] == before_hits + 1
+        assert service.engine.stats()["calls"] == calls_before  # no dispatch
+        assert out.shape == (1, service.engine.embed_dim)
+
+    def test_query_k_validation(self, stack):
+        with pytest.raises(ValueError, match="outside"):
+            stack["service"].query_ids(np.ones((1, _WORDS), np.int32), k=99)
+
+    def test_health_surfaces_resilience_counters(self, stack):
+        h = stack["service"].health()
+        assert h["status"] == "ok"
+        assert h["engine"]["recompiles"] == 0
+        assert h["index"]["recompiles"] == 0
+        for key in ("requests", "flushes", "deadline_expired",
+                    "batch_errors", "occupancy"):
+            assert key in h["batcher"]
+        assert 0.0 <= h["cache"]["hit_rate"] <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# LRU cache (host-only)
+# ---------------------------------------------------------------------------
+
+class TestCache:
+    def test_lru_eviction_order(self):
+        from milnce_tpu.serving.cache import EmbeddingLRUCache
+
+        c = EmbeddingLRUCache(capacity=2)
+        c.put((1,), np.array([1.0]))
+        c.put((2,), np.array([2.0]))
+        assert c.get((1,)) is not None      # refresh 1 -> 2 is now LRU
+        c.put((3,), np.array([3.0]))
+        assert c.get((2,)) is None
+        assert c.get((1,)) is not None and c.get((3,)) is not None
+
+    def test_disabled_cache_never_stores(self):
+        from milnce_tpu.serving.cache import EmbeddingLRUCache
+
+        c = EmbeddingLRUCache(capacity=0)
+        c.put((1,), np.array([1.0]))
+        assert c.get((1,)) is None and len(c) == 0
+
+    def test_stored_rows_are_immutable(self):
+        from milnce_tpu.serving.cache import EmbeddingLRUCache
+
+        c = EmbeddingLRUCache(capacity=4)
+        c.put((1,), np.array([1.0, 2.0]))
+        row = c.get((1,))
+        with pytest.raises(ValueError):
+            row[0] = 99.0
+
+
+# ---------------------------------------------------------------------------
+# HTTP front
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def http_server(stack):
+    from milnce_tpu.serving.service import serve_http
+
+    server = serve_http(stack["service"], port=0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+    server.server_close()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestHTTP:
+    def test_healthz(self, http_server):
+        with urllib.request.urlopen(f"{http_server}/healthz",
+                                    timeout=30) as r:
+            body = json.loads(r.read())
+        assert r.status == 200 and body["status"] == "ok"
+        assert body["engine"]["recompiles"] == 0
+
+    def test_query_by_sentences(self, stack, http_server):
+        status, body = _post(f"{http_server}/v1/query",
+                             {"sentences": ["word1 word2"], "k": 3})
+        assert status == 200
+        (res,) = body["results"]
+        assert len(res["indices"]) == 3 == len(res["scores"])
+        assert all(0 <= i < stack["index"].size for i in res["indices"])
+
+    def test_query_by_token_ids_matches_programmatic(self, stack,
+                                                     http_server):
+        ids = [[1, 2, 3, 0, 0, 0]]
+        status, body = _post(f"{http_server}/v1/query", {"token_ids": ids})
+        assert status == 200
+        _, idx = stack["service"].query_ids(np.asarray(ids, np.int32))
+        assert body["results"][0]["indices"] == idx[0].tolist()
+
+    def test_embed_endpoint(self, stack, http_server):
+        status, body = _post(f"{http_server}/v1/embed_text",
+                             {"token_ids": [[1, 2, 3, 0, 0, 0]]})
+        assert status == 200
+        assert np.asarray(body["embeddings"]).shape == (
+            1, stack["service"].engine.embed_dim)
+
+    def test_bad_request_is_400(self, http_server):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(f"{http_server}/v1/query", {"nonsense": True})
+        assert exc.value.code == 400
+
+    def test_unknown_route_is_404(self, http_server):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(f"{http_server}/v1/nope", {})
+        assert exc.value.code == 404

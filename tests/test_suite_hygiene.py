@@ -26,6 +26,16 @@ _TESTS = os.path.join(_REPO, "tests")
 _EXPENSIVE_FRAGMENTS = ("bench.py", "stage_probe.py", "xla_flag_probe.py",
                         "real_train_eval.py", "._run_config(")
 
+# audited exceptions: child-process tests that are seconds-scale by
+# construction and REQUIRED tier-1 by their ISSUE (a fresh interpreter +
+# tiny preset, not the measurement stack).  Each entry must say why.
+_FAST_CHILD_EXEMPT = {
+    # ISSUE 4 acceptance: serve_bench --preset tiny --duration 1 on CPU
+    # (~20 s incl. jax import); the report format is the contract, so it
+    # must run the real script, and the serving gates pin it tier-1.
+    "test_serve_bench.py::test_cpu_smoke_emits_valid_report",
+}
+
 
 def _is_slow_marked(node, class_slow: bool) -> bool:
     for deco in getattr(node, "decorator_list", []):
@@ -65,7 +75,8 @@ def test_measurement_stack_tests_are_slow_marked():
             calls_real_child = ("._run_config(" in seg
                                 and "monkeypatch" not in seg)
             if ((spawns or calls_real_child)
-                    and not _is_slow_marked(node, class_slow)):
+                    and not _is_slow_marked(node, class_slow)
+                    and f"{fname}::{node.name}" not in _FAST_CHILD_EXEMPT):
                 offenders.append(f"{fname}::{node.name}")
     assert not offenders, (
         "tests spawning the measurement stack must carry "
@@ -164,6 +175,41 @@ def test_chaos_gates_exist_and_stay_tier1():
         assert not slow, (
             "chaos tests must be tier-1/CPU-safe, never @slow (they are "
             f"the fault-path regression fence): {fname}::{slow}")
+
+
+# serving gates (ISSUE 4): the online-serving subsystem's tests — engine
+# bucket ladder, batcher deadline semantics, export round-trip, the
+# served-vs-offline parity pin, and the serve_bench smoke — are the
+# regression fence for the request path.  Same rule as the analysis and
+# chaos gates: tier-1, never @slow, never vanished.
+_SERVING_GATES = ("test_serving.py", "test_serve_batcher.py",
+                  "test_export.py", "test_serve_bench.py")
+
+
+def test_serving_gates_exist_and_stay_tier1():
+    for fname in _SERVING_GATES:
+        path = os.path.join(_TESTS, fname)
+        assert os.path.exists(path), f"serving gate {fname} is missing"
+        src = open(path).read()
+        tests = list(_iter_tests(ast.parse(src)))
+        assert tests, f"{fname} defines no tests"
+        slow = [node.name for node, class_slow in tests
+                if _is_slow_marked(node, class_slow)]
+        assert not slow, (
+            "serving tests must be tier-1/CPU-safe, never @slow (they "
+            f"are the request-path regression fence): {fname}::{slow}")
+
+
+def test_fast_child_exemptions_stay_real():
+    """Every _FAST_CHILD_EXEMPT entry must name a test that still
+    exists — a stale exemption is a hole the audit thinks it covers."""
+    for entry in _FAST_CHILD_EXEMPT:
+        fname, _, test_name = entry.partition("::")
+        path = os.path.join(_TESTS, fname)
+        assert os.path.exists(path), f"exemption names missing file {fname}"
+        names = {node.name for node, _ in
+                 _iter_tests(ast.parse(open(path).read()))}
+        assert test_name in names, f"exemption names missing test {entry}"
 
 
 def test_autotune_artifact_carries_generator_key():

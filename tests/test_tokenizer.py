@@ -44,3 +44,48 @@ def test_non_string_input_stringified():
     # reference tokenizes str(sentence) (video_loader.py:98)
     tok = Tokenizer(["3"], max_words=2)
     assert tok.encode(3).tolist() == [1, 0]
+
+
+def test_concurrent_encode_hammer():
+    """Thread-safety audit gate for the serving request path (ISSUE 4):
+    one shared Tokenizer hammered by N threads must produce exactly the
+    serial goldens — no torn dict reads, no shared scratch state.  The
+    audit's conclusion (module docstring 'Thread safety') is only
+    trustworthy while this test exists."""
+    import threading
+
+    tok = Tokenizer(synthetic_vocab(64), max_words=8)
+    rng = np.random.RandomState(0)
+    sentences = [
+        " ".join(f"word{rng.randint(0, 80)}"          # ~20% OOV on purpose
+                 for _ in range(rng.randint(1, 14)))
+        for _ in range(200)]
+    golden = [tok.encode(s) for s in sentences]       # serial reference
+
+    n_threads, rounds = 8, 5
+    failures: list[str] = []
+    barrier = threading.Barrier(n_threads)
+
+    def hammer(tid: int):
+        order = list(range(len(sentences)))
+        rng_t = np.random.RandomState(tid)
+        for _ in range(rounds):
+            rng_t.shuffle(order)
+            barrier.wait()                 # maximize true concurrency
+            for i in order:
+                got = tok.encode(sentences[i])
+                if not np.array_equal(got, golden[i]):
+                    failures.append(
+                        f"thread {tid} sentence {i}: {got} != {golden[i]}")
+        # batch entry point too
+        got = tok.encode_batch(sentences[:32])
+        if not np.array_equal(got, np.stack(golden[:32])):
+            failures.append(f"thread {tid}: encode_batch diverged")
+
+    threads = [threading.Thread(target=hammer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not failures, failures[:5]

@@ -26,16 +26,21 @@ def test_all_registered_entry_invariants_hold():
     bad = [r.format() for r in results if not r.ok]
     assert not bad, "trace invariants violated:\n" + "\n".join(bad)
     # required coverage: train step, softdtw, retrieval (the ISSUE floor)
+    # + the serving entries (ISSUE 4: bucket-ladder recompile gate and
+    # pinned index collectives)
     entries = {r.entry for r in results}
     assert {"train_step_milnce", "train_step_milnce_guarded",
             "train_step_sdtw3",
             "grad_cache_step_milnce", "video_embed", "text_embed",
-            "softdtw_scan_grad", "param_treedef"} <= entries
+            "softdtw_scan_grad", "param_treedef",
+            "serve_embed_ladder", "serve_text_embed", "serve_video_embed",
+            "serve_index_topk"} <= entries
     # the double-call recompile detector ran on every executable entry
     recompiled = {r.entry for r in results if r.check == "recompile"}
     assert {"train_step_milnce", "train_step_milnce_guarded",
             "video_embed", "text_embed",
-            "softdtw_scan_grad"} <= recompiled
+            "softdtw_scan_grad", "serve_embed_ladder",
+            "serve_index_topk"} <= recompiled
 
 
 def test_f64_detector_catches_planted_upcast():
