@@ -522,6 +522,13 @@ def _is_oom(exc) -> bool:
 def _make_record(best, frames, size, on_tpu, kind):
     value = best["clips_per_sec_per_chip"]
     out = {
+        # versioned obs envelope (milnce_tpu/obs/export.py): train bench
+        # records share one schema with SERVE_BENCH_*.json and registry
+        # snapshots, so scripts/obs_report.py can summarize/gate all of
+        # them.  Literal (not imported): the record must survive even if
+        # the package import path is broken on a bring-up host.
+        "schema": "milnce.obs/v1",
+        "kind": "train_bench",
         "metric": f"train_step clips/sec/chip ({frames}f@{size}, "
                   f"{best['dtype']}, batch {best['batch']}"
                   + (", s2d stem" if best.get("s2d") else "")

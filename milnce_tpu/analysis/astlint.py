@@ -51,6 +51,12 @@ _TAINT_BREAKERS = {"shape", "ndim", "dtype", "size", "sharding", "aval",
 _SYNC_BARE = {"float", "int", "bool", "complex"}
 _SYNC_NP = {"asarray", "array"}
 
+# Observability recording verbs (GL008): method calls on a registry
+# metric / span recorder (milnce_tpu/obs/) — host I/O that must never
+# sit under a trace.  Deliberately EXCLUDES ``set``: ``x.at[i].set(v)``
+# is ubiquitous legitimate traced code.
+_OBS_RECORDING = {"span", "event", "observe", "inc", "dec", "log_event"}
+
 _ARRAY_ROOTS = {"np", "numpy", "jnp"}
 _FLOAT_DEFAULT_CTORS = {"zeros", "ones", "empty", "linspace", "eye"}
 _VALUE_CTORS = {"array", "asarray", "full"}
@@ -295,6 +301,13 @@ class _ModuleLint:
                            else [stmt.target])
                 for t in targets:
                     tainted.update(_assigned_names(t))
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            # iter_child_nodes yields ast.withitem (neither stmt nor
+            # expr), so `with REC.span(...):` would slip the expression
+            # walk — check the context managers explicitly (GL008's
+            # canonical spelling is exactly a with-statement)
+            for item in stmt.items:
+                self._check_traced_exprs(item.context_expr, tainted)
         if isinstance(stmt, ast.If) and _expr_tainted(stmt.test, tainted):
             self._emit("GL002", stmt,
                        "Python `if` on a traced value — use lax.cond / "
@@ -326,6 +339,14 @@ class _ModuleLint:
                 self._emit("GL006", sub,
                            "print() under trace fires once with tracers — "
                            "use jax.debug.print")
+            elif (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in _OBS_RECORDING):
+                self._emit("GL008", sub,
+                           f".{sub.func.attr}() under trace is host I/O "
+                           "that fires once with tracers — record outside "
+                           "the traced function (display cadence / "
+                           "dispatch site)")
 
     # ---- GL001: hot-region host syncs -----------------------------------
 

@@ -131,6 +131,27 @@ _RULE_LIST = (
             "exc_info to a logging call, or suppress with a reason: "
             "# graftlint: disable=GL007(<why swallowing is correct here>)",
     ),
+    Rule(
+        id="GL008",
+        name="obs-under-trace",
+        summary="metrics/span recording reachable inside jit-traced code",
+        rationale="Registry counters and span recorders are HOST I/O "
+                  "(locks, ring appends, line-buffered file writes — "
+                  "milnce_tpu/obs/).  Under jit they fire exactly once at "
+                  "trace time with tracer values: what reads like per-step "
+                  "telemetry records garbage once and then never again, "
+                  "and routing it through a callback instead pins a host "
+                  "sync into the step.  Recording belongs OUTSIDE the "
+                  "traced function, at the existing host boundary "
+                  "(display cadence / the dispatch site).",
+        example="with REC.span('inner'):  # inside the jitted step body\n"
+                "    loss = loss_fn(params)\n"
+                "METRICS.inc()             # ditto",
+        fix="move the .inc()/.observe()/.span()/.event() call outside the "
+            "traced function (train/loop.py feeds the registry from the "
+            "display-cadence fetch); genuinely trace-time-only setup gets "
+            "# graftlint: disable=GL008(<why this is trace-time setup>)",
+    ),
 )
 
 RULES: dict[str, Rule] = {r.id: r for r in _RULE_LIST}

@@ -215,6 +215,16 @@ class TrainConfig:
     num_windows_test: int = 4
     verbose: bool = True
     trace_dir: str = ""                 # jax.profiler trace output ('' = off)
+    obs_dir: str = ""                   # span/event stream: RUN_EVENTS.jsonl
+                                        # is appended under this dir ('' =
+                                        # log_root; written only when the
+                                        # run logger is enabled).  Recording
+                                        # is host-side only — obs/,
+                                        # OBSERVABILITY.md
+    obs_profiler_bridge: bool = False   # wrap spans in jax.profiler.
+                                        # TraceAnnotation so they land in
+                                        # real TPU traces (pairs with
+                                        # trace_dir)
     halt_on_nan: bool = True            # checkpoint + halt when the windowed
                                         # loss goes non-finite (divergence guard)
     max_steps: Optional[int] = None     # stop (with a checkpoint) after N

@@ -33,6 +33,7 @@ from typing import Optional
 import numpy as np
 
 from milnce_tpu.config import DataConfig, ModelConfig
+from milnce_tpu.obs import metrics as obs_metrics
 from milnce_tpu.data.captions import CaptionTrack, sample_caption
 from milnce_tpu.data.tokenizer import Tokenizer, synthetic_vocab
 from milnce_tpu.data.video import (ClipDecoder, black_sample, build_decoder,
@@ -42,6 +43,14 @@ from milnce_tpu.data.video import (ClipDecoder, black_sample, build_decoder,
 def read_csv(path: str) -> list[dict]:
     with open(path, newline="") as f:
         return list(csv_mod.DictReader(f))
+
+
+# Decode-failure telemetry on the process-wide registry (incremented
+# from reader threads; the display log line keeps its own per-source
+# counter for the human-facing totals — OBSERVABILITY.md).
+_OBS_DECODE_FAILURES = obs_metrics.registry().counter(
+    "milnce_data_decode_failures_total",
+    "samples whose caption load or decode raised (before resample)")
 
 
 class DataHealthError(RuntimeError):
@@ -134,6 +143,7 @@ class HowTo100MSource:
                 "start": np.float32(start)}   # CIDM loss input (loss.py:56)
 
     def _record_failure(self, idx: int, exc: Exception) -> None:
+        _OBS_DECODE_FAILURES.inc()
         with self._stats_lock:
             self.decode_failures += 1
             count = self.decode_failures

@@ -27,6 +27,18 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from milnce_tpu.obs import metrics as obs_metrics
+from milnce_tpu.obs import spans as obs_spans
+
+# Decode-watchdog telemetry on the process-wide registry (host-side
+# counters incremented from reader threads — OBSERVABILITY.md).
+_OBS_TIMEOUTS = obs_metrics.registry().counter(
+    "milnce_data_decode_timeouts_total",
+    "decode futures that exceeded the watchdog timeout (wedged decodes)")
+_OBS_RETRIES = obs_metrics.registry().counter(
+    "milnce_data_decode_retries_total",
+    "fresh decode attempts resubmitted by the watchdog")
+
 
 class ShardedLoader:
     """Iterates a source (len + sample(idx, rng)) as per-host batches.
@@ -93,6 +105,11 @@ class ShardedLoader:
                 wedged = not fut.cancel()
                 if wedged:
                     self.decode_timeouts += 1
+                    _OBS_TIMEOUTS.inc()
+                    obs_spans.get_recorder().event(
+                        "decode.timeout", sample=int(idx),
+                        attempt=attempt + 1,
+                        timeout_s=self.sample_timeout * (2 ** attempt))
                     if self._logged_timeouts < self.LOGGED_TIMEOUTS:
                         self._logged_timeouts += 1
                         self._log(
@@ -103,6 +120,10 @@ class ShardedLoader:
                             f"{self.timeout_retries + 1}; total timeouts: "
                             f"{self.decode_timeouts})")
                 if attempt < self.timeout_retries:
+                    _OBS_RETRIES.inc()
+                    obs_spans.get_recorder().event(
+                        "decode.retry", sample=int(idx),
+                        attempt=attempt + 2)
                     fut = pool.submit(fetch, idx)
         fallback = getattr(self.source, "fallback_sample", None)
         if fallback is not None:

@@ -1,0 +1,24 @@
+"""Unified observability: metrics registry, span tracing, exposition.
+
+One subsystem replaces the three ad-hoc counter paths that grew with the
+stack (the train loop's display log line, the serving ``/healthz`` dict,
+per-tool JSON artifacts with incompatible schemas):
+
+- :mod:`milnce_tpu.obs.metrics` — process-wide, thread-safe typed
+  registry (Counter / Gauge / Histogram, labeled families);
+- :mod:`milnce_tpu.obs.spans` — monotonic-clock span/event recorder
+  (append-only ``RUN_EVENTS.jsonl`` + in-memory ring, opt-in
+  ``jax.profiler.TraceAnnotation`` bridge);
+- :mod:`milnce_tpu.obs.export` — Prometheus text exposition and the
+  versioned JSON snapshot schema shared by bench.py, serve_bench.py
+  and the train loop.
+
+The load-bearing invariant (OBSERVABILITY.md): **recording is host-side
+only and never adds a device sync**.  Nothing in this package imports
+jax at module scope; recording a device value is a :class:`TypeError`,
+not a silent ``float()`` sync; and the ``train_step_milnce_instrumented``
+trace invariant pins the instrumented train step's collectives identical
+to the uninstrumented step under ``jax.transfer_guard("disallow")``.
+"""
+
+from milnce_tpu.obs import export, metrics, spans  # noqa: F401

@@ -49,8 +49,16 @@ import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
+from milnce_tpu.obs import metrics as obs_metrics
+
 KNOWN_SITES = ("decode.raise", "decode.hang", "ckpt.save_ioerror",
                "grad.nonfinite")
+
+# Process-wide injection telemetry (OBSERVABILITY.md): chaos drills and
+# failure-rate dashboards read how often each site actually fired.
+_INJECTED = obs_metrics.registry().counter(
+    "milnce_faults_injected_total",
+    "fault-site occurrences that fired (scheduled hits)", ("site",))
 
 ENV_VAR = "MILNCE_FAULTS"
 
@@ -130,7 +138,10 @@ class FaultRegistry:
         with self._lock:
             s.hits += 1
             n = s.hits
-        return s if s.scheduled(n) else None
+        if not s.scheduled(n):
+            return None
+        _INJECTED.labels(site=site).inc()
+        return s
 
 
 _registry: FaultRegistry | None = None

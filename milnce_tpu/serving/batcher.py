@@ -28,7 +28,9 @@ in flight: once a batch is submitted its rows get their results.
 numpy-only on purpose: payloads and results are host arrays; every
 device interaction lives behind the injected ``run_batch`` callable.
 Thread safety: ``submit`` may be called from any number of threads;
-one worker thread owns the flush path; counters are lock-guarded.
+one worker thread owns the flush path; every counter lives on the obs
+metrics registry (lock-guarded there — OBSERVABILITY.md), so request
+threads and the worker can no longer race an unlocked dict.
 """
 
 from __future__ import annotations
@@ -41,6 +43,9 @@ from dataclasses import dataclass
 from typing import Callable, Optional
 
 import numpy as np
+
+from milnce_tpu.obs import metrics as obs_metrics
+from milnce_tpu.obs import spans as obs_spans
 
 # The worker wakes this soon after the nearest deadline so an expired
 # request fails promptly (bounded staleness of the expiry verdict).
@@ -85,26 +90,79 @@ class DynamicBatcher:
     - ``max_delay_ms``: delay-flush bound.
     - ``default_timeout_ms``: deadline applied to submits that don't pass
       their own; 0 disables.
+    - ``registry``: obs metrics registry the counters/occupancy histogram
+      land on (None = a private one, so standalone batchers stay
+      isolated; the service passes its registry down so ``GET /metrics``
+      sees the request path).
+    - ``buckets``: the engine's ladder, used as the occupancy histogram's
+      fixed edges (None = powers of two up to ``max_batch``).
     """
 
     def __init__(self, run_batch: Callable[[np.ndarray], np.ndarray],
                  bucket_for: Callable[[int], int], *, max_batch: int,
                  max_delay_ms: float = 5.0, default_timeout_ms: float = 0.0,
-                 name: str = "batcher"):
+                 name: str = "batcher",
+                 registry: Optional[obs_metrics.MetricsRegistry] = None,
+                 buckets: Optional[tuple] = None,
+                 recorder: Optional[obs_spans.SpanRecorder] = None):
         assert max_batch >= 1
         self._run_batch = run_batch
+        # flush spans go to the injected recorder when the owner (the
+        # service) isolates one; None = the process default, resolved at
+        # flush time so a later spans.install() is honored
+        self._recorder = recorder
         self._bucket_for = bucket_for
         self.max_batch = int(max_batch)
         self.max_delay_s = float(max_delay_ms) / 1000.0
         self.default_timeout_ms = float(default_timeout_ms)
+        self.name = name
         self._q: queue.Queue[_Request] = queue.Queue()
-        self._lock = threading.Lock()
         self._closed = threading.Event()
-        self._requests = 0
-        self._flushes = 0
-        self._expired = 0
-        self._batch_errors = 0
-        self._occupancy: dict[int, list[int]] = {}   # bucket -> [flushes, rows]
+        self.registry = registry if registry is not None \
+            else obs_metrics.MetricsRegistry()
+        if buckets is None:
+            buckets, b = [], 1
+            while b < self.max_batch:
+                buckets.append(b)
+                b *= 2
+            buckets.append(self.max_batch)
+        lbl = {"batcher": name}
+        reg = self.registry
+        self._m_requests = reg.counter(
+            "milnce_serve_requests_total",
+            "rows submitted to the batcher", ("batcher",)).labels(**lbl)
+        self._m_flushes = reg.counter(
+            "milnce_serve_flushes_total",
+            "batches executed", ("batcher",)).labels(**lbl)
+        self._m_expired = reg.counter(
+            "milnce_serve_deadline_expired_total",
+            "requests failed with DeadlineExpired while queued",
+            ("batcher",)).labels(**lbl)
+        self._m_batch_errors = reg.counter(
+            "milnce_serve_batch_errors_total",
+            "batch executions that failed (propagated to every caller)",
+            ("batcher",)).labels(**lbl)
+        self._m_occupancy = reg.histogram(
+            "milnce_serve_batch_occupancy",
+            "live rows per executed batch (bucket edges = the ladder)",
+            buckets=tuple(buckets), labels=("batcher",)).labels(**lbl)
+        self._f_bucket_flushes = reg.counter(
+            "milnce_serve_bucket_flushes_total",
+            "batches executed per padded bucket size",
+            ("batcher", "bucket"))
+        self._f_bucket_rows = reg.counter(
+            "milnce_serve_bucket_rows_total",
+            "live rows executed per padded bucket size",
+            ("batcher", "bucket"))
+        # cached per-bucket child handles (resolved once per bucket on
+        # the worker thread).  Children are keyed by label values, so
+        # two batchers sharing a registry AND a name read combined
+        # totals — isolation is a private registry (the default) or a
+        # distinct name, not this cache.  Lock-guarded: the worker
+        # inserts on a bucket's first flush while request threads
+        # iterate it in stats() (/healthz)
+        self._bucket_children: dict[int, tuple] = {}
+        self._children_lock = threading.Lock()
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name=f"{name}-worker")
         self._worker.start()
@@ -122,8 +180,7 @@ class DynamicBatcher:
         t_ms = self.default_timeout_ms if timeout_ms is None else timeout_ms
         deadline = (time.monotonic() + t_ms / 1000.0) if t_ms > 0 else None
         fut: Future = Future()
-        with self._lock:
-            self._requests += 1
+        self._m_requests.inc()
         self._q.put(_Request(np.asarray(payload), fut, deadline))
         if self._closed.is_set():
             # close() raced the put above: the worker may already have
@@ -171,8 +228,7 @@ class DynamicBatcher:
             else:
                 live.append(r)
         if expired:
-            with self._lock:
-                self._expired += expired
+            self._m_expired.inc(expired)
         if not live:
             return
         n = len(live)
@@ -183,21 +239,31 @@ class DynamicBatcher:
             # dead worker would strand every later submit forever
             bucket = self._bucket_for(n)
             rows = pad_rows(np.stack([r.payload for r in live]), bucket)
-            out = np.asarray(self._run_batch(rows))
+            rec = self._recorder if self._recorder is not None \
+                else obs_spans.get_recorder()
+            with rec.span("batcher.flush", batcher=self.name,
+                          bucket=bucket, rows=n):
+                out = np.asarray(self._run_batch(rows))
         except Exception as exc:
             # batch failure -> every caller sees the error (never a hang)
             for r in live:
                 r.future.set_exception(exc)
-            with self._lock:
-                self._batch_errors += 1
+            self._m_batch_errors.inc()
             return
         for i, r in enumerate(live):
             r.future.set_result(out[i])
-        with self._lock:
-            self._flushes += 1
-            ent = self._occupancy.setdefault(bucket, [0, 0])
-            ent[0] += 1
-            ent[1] += n
+        self._m_flushes.inc()
+        self._m_occupancy.observe(n)
+        children = self._bucket_children.get(bucket)
+        if children is None:            # insert: worker thread only
+            children = (
+                self._f_bucket_flushes.labels(batcher=self.name,
+                                              bucket=bucket),
+                self._f_bucket_rows.labels(batcher=self.name, bucket=bucket))
+            with self._children_lock:
+                self._bucket_children[bucket] = children
+        children[0].inc()
+        children[1].inc(n)
 
     @staticmethod
     def _past_ms(r: _Request, now: float) -> float:
@@ -228,16 +294,21 @@ class DynamicBatcher:
     def stats(self) -> dict:
         """Counters + the batch-occupancy histogram (bucket -> how full
         batches ran) — the number that tells you whether max_delay_ms is
-        tuned right for the offered load."""
-        with self._lock:
-            occupancy = {
-                str(b): {"flushes": f, "rows": rows,
-                         "mean_fill": (rows / (f * b)) if f else 0.0}
-                for b, (f, rows) in sorted(self._occupancy.items())}
-            return {
-                "requests": self._requests,
-                "flushes": self._flushes,
-                "deadline_expired": self._expired,
-                "batch_errors": self._batch_errors,
-                "occupancy": occupancy,
-            }
+        tuned right for the offered load.  Keys are the pre-registry
+        ``/healthz`` contract; the values now READ the registry metrics
+        (one source of truth — SERVING.md observability section)."""
+        occupancy = {}
+        with self._children_lock:
+            children = sorted(self._bucket_children.items())
+        for b, (fc, rc) in children:
+            f, rows = int(fc.value), int(rc.value)
+            occupancy[str(b)] = {
+                "flushes": f, "rows": rows,
+                "mean_fill": (rows / (f * b)) if f else 0.0}
+        return {
+            "requests": int(self._m_requests.value),
+            "flushes": int(self._m_flushes.value),
+            "deadline_expired": int(self._m_expired.value),
+            "batch_errors": int(self._m_batch_errors.value),
+            "occupancy": occupancy,
+        }

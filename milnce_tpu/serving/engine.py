@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
+from milnce_tpu.obs import spans as obs_spans
 from milnce_tpu.parallel.mesh import batch_sharding, replicated
 from milnce_tpu.serving.batcher import pad_rows
 from milnce_tpu.train.step import make_text_embed_fn, make_video_embed_fn
@@ -192,9 +193,11 @@ class InferenceEngine:
         executable the engine will ever run exists before the first
         request, then snapshot the jit cache sizes — any later growth is
         a recompile (:meth:`recompiles`)."""
-        for b in self.buckets:
-            self.embed_text(np.zeros((b, self.text_words), np.int32))
-            self.embed_video(np.zeros((b,) + self.video_shape, np.uint8))
+        with obs_spans.get_recorder().span("ladder.warmup",
+                                           buckets=list(self.buckets)):
+            for b in self.buckets:
+                self.embed_text(np.zeros((b, self.text_words), np.int32))
+                self.embed_video(np.zeros((b,) + self.video_shape, np.uint8))
         self._baseline_cache = self._cache_sizes()
 
     def _cache_sizes(self) -> dict:

@@ -24,6 +24,12 @@ Endpoints (JSON in/out):
                            error / deadline-expired totals, engine
                            recompile count, batch-occupancy histogram,
                            cache hit rate, index size.
+- ``GET  /metrics``        Prometheus text exposition of the service's
+                           obs registry (request counters, batcher
+                           occupancy histogram, cache hit rate,
+                           recompile gauge — OBSERVABILITY.md).
+- ``GET  /obs/events``     the span recorder's in-memory ring as JSON
+                           (``?n=`` limits to the most recent N).
 
 Deadline semantics: ``timeout_ms`` bounds a request's QUEUE wait in the
 batcher (ROBUSTNESS.md "Serving request path").  An expired request
@@ -35,13 +41,15 @@ from __future__ import annotations
 
 import json
 import logging
-import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import numpy as np
 
+from milnce_tpu.obs import export as obs_export
+from milnce_tpu.obs import metrics as obs_metrics
+from milnce_tpu.obs import spans as obs_spans
 from milnce_tpu.serving.batcher import DeadlineExpired, DynamicBatcher
 from milnce_tpu.serving.cache import EmbeddingLRUCache, token_key
 
@@ -59,20 +67,58 @@ class RetrievalService:
 
     def __init__(self, engine, index=None, *, tokenizer=None,
                  cache: Optional[EmbeddingLRUCache] = None,
-                 max_delay_ms: float = 5.0, default_timeout_ms: float = 0.0):
+                 max_delay_ms: float = 5.0, default_timeout_ms: float = 0.0,
+                 registry: Optional[obs_metrics.MetricsRegistry] = None,
+                 recorder: Optional[obs_spans.SpanRecorder] = None):
         self.engine = engine
         self.index = index
         self.tokenizer = tokenizer
         self.cache = cache if cache is not None else EmbeddingLRUCache(0)
+        # Every counter on the request path lives on ONE obs registry
+        # (the old per-component dicts raced request threads against the
+        # batcher worker; registry metrics are lock-guarded).  None = a
+        # private registry, so multiple services in one process stay
+        # isolated; the milnce-serve CLI passes the process-wide
+        # ``obs.metrics.registry()``.
+        self.registry = registry if registry is not None \
+            else obs_metrics.MetricsRegistry()
+        # None = the process-default recorder, resolved PER USE (not
+        # captured here): a later ``spans.install()`` — e.g. a train run
+        # in the same process — must divert this service's spans and the
+        # ``/obs/events`` ring together, never split them
+        self._recorder = recorder
         self._batcher = DynamicBatcher(
             engine.embed_text, engine.bucket_for, max_batch=engine.max_batch,
             max_delay_ms=max_delay_ms, default_timeout_ms=default_timeout_ms,
-            name="text")
+            name="text", registry=self.registry, buckets=engine.buckets,
+            recorder=recorder)
         self._default_timeout_ms = float(default_timeout_ms)
-        self._lock = threading.Lock()
         self._started = time.time()
-        self._queries = 0
-        self._errors = 0
+        reg = self.registry
+        self._m_queries = reg.counter(
+            "milnce_serve_queries_total", "retrieval queries received")
+        self._m_errors = reg.counter(
+            "milnce_serve_query_errors_total", "retrieval queries failed")
+        # collect-time gauges: values owned by other components, read at
+        # scrape/snapshot — never cached stale, never double-counted
+        reg.gauge("milnce_serve_uptime_seconds", "seconds since boot",
+                  fn=lambda: time.time() - self._started)
+        reg.gauge("milnce_serve_engine_recompiles",
+                  "jit-cache entries created since the warmup sweep "
+                  "(must stay 0; -1 = no introspection on this jax)",
+                  fn=engine.recompiles)
+        reg.gauge("milnce_serve_cache_hits",
+                  "text-embedding cache hits",
+                  fn=lambda: self.cache.stats()["hits"])
+        reg.gauge("milnce_serve_cache_misses",
+                  "text-embedding cache misses",
+                  fn=lambda: self.cache.stats()["misses"])
+        reg.gauge("milnce_serve_cache_hit_rate",
+                  "hits / (hits + misses), 0 before traffic",
+                  fn=lambda: self.cache.stats()["hit_rate"])
+        if index is not None:
+            reg.gauge("milnce_serve_index_size", "corpus rows indexed",
+                      fn=lambda: self.index.stats()["size"])
 
     # ---- embedding path --------------------------------------------------
 
@@ -118,14 +164,12 @@ class RetrievalService:
         k = self.index.k if k is None else int(k)
         if not 1 <= k <= self.index.k:
             raise ValueError(f"k={k} outside [1, index k={self.index.k}]")
-        with self._lock:
-            self._queries += len(token_ids)
+        self._m_queries.inc(len(token_ids))
         try:
             emb = self.embed_text_ids(token_ids, timeout_ms)
             scores, idx = self.index.topk(emb)
         except Exception:
-            with self._lock:
-                self._errors += len(token_ids)
+            self._m_errors.inc(len(token_ids))
             raise
         return scores[:, :k], idx[:, :k]
 
@@ -137,18 +181,30 @@ class RetrievalService:
     # ---- lifecycle / observability --------------------------------------
 
     def health(self) -> dict:
-        with self._lock:
-            queries, errors = self._queries, self._errors
+        """The pre-registry ``/healthz`` contract, keys unchanged —
+        every value now reads the obs registry (or a component stats()
+        that itself reads the registry)."""
         return {
             "status": "ok",
             "uptime_s": time.time() - self._started,
-            "queries": queries,
-            "query_errors": errors,
+            "queries": int(self._m_queries.value),
+            "query_errors": int(self._m_errors.value),
             "engine": self.engine.stats(),
             "batcher": self._batcher.stats(),
             "cache": self.cache.stats(),
             "index": self.index.stats() if self.index is not None else None,
         }
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the service registry."""
+        return obs_export.to_prometheus(self.registry)
+
+    @property
+    def recorder(self) -> obs_spans.SpanRecorder:
+        """The recorder ``/obs/events`` serves: the injected one, else
+        whatever is CURRENTLY installed as the process default."""
+        return self._recorder if self._recorder is not None \
+            else obs_spans.get_recorder()
 
     def close(self) -> None:
         self._batcher.close()
@@ -167,15 +223,34 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _reply(self, code: int, payload: dict) -> None:
         body = json.dumps(payload).encode()
+        self._reply_raw(code, body, "application/json")
+
+    def _reply_raw(self, code: int, body: bytes, content_type: str) -> None:
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
 
     def do_GET(self) -> None:
-        if self.path.rstrip("/") in ("/healthz", "/health"):
+        from urllib.parse import parse_qs, urlparse
+
+        url = urlparse(self.path)
+        route = url.path.rstrip("/")
+        if route in ("/healthz", "/health"):
             self._reply(200, self.service.health())
+        elif route == "/metrics":
+            self._reply_raw(200, self.service.metrics_text().encode(),
+                            obs_export.PROMETHEUS_CONTENT_TYPE)
+        elif route == "/obs/events":
+            n = parse_qs(url.query).get("n", [None])[0]
+            try:
+                n = int(n) if n else None
+            except ValueError:
+                self._reply(400, {"error": f"n must be an integer, "
+                                           f"got {n!r}"})
+                return
+            self._reply(200, {"events": self.service.recorder.tail(n)})
         else:
             self._reply(404, {"error": f"no route {self.path!r}"})
 
@@ -297,13 +372,17 @@ def main(argv=None) -> None:
     service = RetrievalService(
         engine, index, tokenizer=tokenizer,
         cache=EmbeddingLRUCache(s.cache_capacity),
-        max_delay_ms=s.max_delay_ms, default_timeout_ms=s.default_timeout_ms)
+        max_delay_ms=s.max_delay_ms, default_timeout_ms=s.default_timeout_ms,
+        # the live process has ONE registry: /metrics on this server
+        # also exposes anything other subsystems record process-wide
+        registry=obs_metrics.registry())
     server = serve_http(service, s.host, s.port)
     # flush: operators poll a redirected log for this readiness line
     print(f"milnce-serve: listening on http://{s.host}:"
           f"{server.server_address[1]} (buckets {engine.buckets}, "
           f"index={'none' if index is None else index.size}, "
-          f"tokenizer={'yes' if tokenizer else 'token_ids-only'})",
+          f"tokenizer={'yes' if tokenizer else 'token_ids-only'}; "
+          f"Prometheus scrape: /metrics)",
           flush=True)
     try:
         server.serve_forever()

@@ -14,8 +14,15 @@ from typing import Optional, Tuple
 
 import orbax.checkpoint as ocp
 
+from milnce_tpu.obs import metrics as obs_metrics
 from milnce_tpu.resilience import faults
 from milnce_tpu.train.state import TrainState
+
+# Transient-save-failure telemetry (OBSERVABILITY.md): nonzero retries
+# on a healthy store is the early-warning signal for flaky storage.
+_OBS_SAVE_RETRIES = obs_metrics.registry().counter(
+    "milnce_ckpt_save_retries_total",
+    "checkpoint save submits retried after a transient OSError")
 
 
 _STALE_PREFIX = "stale-epoch-"   # non-numeric => invisible to Orbax's step scan
@@ -123,6 +130,7 @@ class CheckpointManager:
             except OSError as exc:
                 if attempt >= retries:
                     raise
+                _OBS_SAVE_RETRIES.inc()
                 delay = self.retry_backoff * (2 ** attempt)
                 logging.getLogger(__name__).warning(
                     "checkpoint save of epoch %d failed (%s: %s); retrying "

@@ -27,6 +27,8 @@ from milnce_tpu.data.pipeline import (ShardedLoader, device_prefetch,
                                       flatten_text, shard_placer)
 from milnce_tpu.data.synthetic import SyntheticVideoTextSource
 from milnce_tpu.models.build import build_model
+from milnce_tpu.obs import metrics as obs_metrics
+from milnce_tpu.obs import spans as obs_spans
 from milnce_tpu.parallel.mesh import (build_mesh, initialize_distributed,
                                       replicate_to_mesh)
 from milnce_tpu.resilience import faults
@@ -166,6 +168,34 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
     logger.log(f"mesh: {mesh.shape} | devices: {len(jax.devices())} "
                f"| global batch: {cfg.train.batch_size}")
 
+    # Observability (obs/, OBSERVABILITY.md): an append-only span/event
+    # stream (RUN_EVENTS.jsonl) plus display-cadence metrics on the
+    # process-wide registry.  Recording is HOST-side only — the gauges
+    # are fed exclusively from values the display fetch already
+    # materialized, and the per-step span times host dispatch, never the
+    # device (pinned by the train_step_milnce_instrumented trace
+    # invariant: identical collectives, survives the transfer guard).
+    obs_dir = cfg.train.obs_dir or cfg.train.log_root
+    rec_path = None
+    if logger.enabled and obs_dir:
+        os.makedirs(obs_dir, exist_ok=True)
+        rec_path = os.path.join(obs_dir, "RUN_EVENTS.jsonl")
+    rec = obs_spans.SpanRecorder(
+        path=rec_path, profiler_bridge=cfg.train.obs_profiler_bridge)
+    reg = obs_metrics.registry()
+    m_steps = reg.counter("milnce_train_steps_total",
+                          "optimizer steps dispatched (display-cadence fed)")
+    g_loss = reg.gauge("milnce_train_loss",
+                       "windowed mean training loss at the last display")
+    g_lr = reg.gauge("milnce_train_learning_rate",
+                     "current LR (numpy host-schedule twin)")
+    g_tput = reg.gauge("milnce_train_clips_per_sec",
+                       "windowed throughput at the last display")
+    g_skipped = reg.gauge("milnce_train_skipped_steps",
+                          "finite-guard skipped updates (run total)")
+    m_rollbacks = reg.counter("milnce_train_rollbacks_total",
+                              "circuit-breaker checkpoint restores")
+
     source = build_source(cfg, log_fn=logger.log)
     loader = ShardedLoader(source, cfg.train.batch_size, seed=cfg.train.seed,
                            num_threads=cfg.data.num_reader_threads,
@@ -201,7 +231,8 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
     start_epoch = 0
     resume_skip = 0
     if cfg.train.resume:
-        start_epoch, state = manager.restore_latest(state)
+        with rec.span("ckpt.restore", label="latest"):
+            start_epoch, state = manager.restore_latest(state)
         # Mid-epoch checkpoints (preemption / max_steps) are labeled with
         # the CURRENT epoch; the restored step counter places us inside it,
         # and the loader skips the consumed batches at the index level so
@@ -345,6 +376,8 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
             f"training loss became non-finite ({mean_loss}) at step "
             f"{step_label}")
 
+    prev_rec = obs_spans.install(rec)   # pipeline watchdog events land
+                                        # in this run's stream
     try:
       with maybe_trace(cfg.train.trace_dir or None):
         # Steady state: IMPLICIT device transfers are a bug (a hidden
@@ -364,11 +397,16 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                                          depth=cfg.data.prefetch_depth):
                 video, text = flatten_text(batch)
                 start = batch.get("start", zero_start)
-                if guard_on:
-                    state, loss, skipped = step_fn(state, video, text, start)
-                    skipped = skipped.addressable_data(0)
-                else:
-                    state, loss = step_fn(state, video, text, start)
+                # span times HOST dispatch of the async step (device
+                # truth needs the profiler bridge / trace_dir) — no
+                # sync, no transfer, file write is line-buffered host IO
+                with rec.span("step", step=total_steps + 1):
+                    if guard_on:
+                        state, loss, skipped = step_fn(state, video, text,
+                                                       start)
+                        skipped = skipped.addressable_data(0)
+                    else:
+                        state, loss = step_fn(state, video, text, start)
                 # Accumulate on the PROCESS-LOCAL replica of the (P()-
                 # replicated) loss: a zero-copy shard view.  Eager/jit
                 # arithmetic on the multi-process global array itself is
@@ -430,6 +468,18 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                         f"{mean_loss:.4f}, "
                         f"Learning rate: {lr:.6f}, Throughput: "
                         f"{timer.clips_per_sec:.1f} clips/s{extra}")
+                    # registry feed: ONLY host values the fetch above
+                    # already materialized (the tentpole invariant —
+                    # no extra device_get, no per-step recording)
+                    m_steps.inc(window)
+                    g_loss.set(mean_loss)
+                    g_lr.set(lr)
+                    g_tput.set(timer.clips_per_sec)
+                    if guard_on:
+                        g_skipped.set(k_total)
+                    rec.event("display", step=opt_step, epoch=epoch + 1,
+                              loss=float(mean_loss), lr=float(lr),  # graftlint: disable=GL001(json-coercion of the host numpy values the display fetch above already materialized, not device values)
+                              clips_per_sec=timer.clips_per_sec)
                     # a guarded window with ZERO applied updates displays
                     # nan by construction — that is the breaker's case to
                     # handle, not the halt-on-nan divergence guard's
@@ -470,11 +520,16 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                                     "instead of rolling back in a loop")
                         last_rollback = (total_steps, k_total)
                         manager.wait()
-                        restored = manager.restore(latest, state)
+                        with rec.span("ckpt.restore", label=int(latest)):  # graftlint: disable=GL001(host epoch label from Orbax's step listing, not a device value)
+                            restored = manager.restore(latest, state)
                         state = restored.replace(
                             step=jnp.asarray(opt_step, jnp.int32))
                         state = replicate_to_mesh(state, mesh)
                         rollbacks += 1
+                        m_rollbacks.inc()
+                        rec.event("rollback", step=opt_step,
+                                  restored_epoch=int(latest),  # graftlint: disable=GL001(host epoch label from Orbax's step listing, not a device value)
+                                  consecutive_skips=consec)
                         consec_dev = None       # fresh weights: reset streak
                         logger.log(
                             f"circuit breaker: {consec} consecutive "
@@ -510,18 +565,26 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                     # tests/test_resilience.py + test_train.py
                     label, force = stop_save_label(
                         epoch, opt_step0 + total_steps, steps_per_epoch)
-                    manager.save(label, state, force=force)
-                    manager.wait()
+                    with rec.span("ckpt.save", label=label, forced=force):
+                        manager.save(label, state, force=force)
+                        manager.wait()
                     last, skips = exit_metrics()
                     return TrainResult(state, total_steps, last,
                                        skips, rollbacks)
             with jax.transfer_guard("allow"):       # epoch-boundary save
-                manager.save(epoch + 1, state)
+                # the span times the async SUBMIT (Orbax writes in the
+                # background); the stop-save span above times a full
+                # submit+wait
+                with rec.span("ckpt.save", label=epoch + 1, forced=False):
+                    manager.save(epoch + 1, state)
     finally:
         manager.wait()
         if cfg.train.faults:
             faults.disarm()     # a config-armed registry dies with the run
         if prev_handler is not None:
             signal.signal(signal.SIGTERM, prev_handler)
+        obs_spans.install(prev_rec)     # this run's stream detaches
+        rec.close()
+        logger.close()
     last, skips = exit_metrics()
     return TrainResult(state, total_steps, last, skips, rollbacks)

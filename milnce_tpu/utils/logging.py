@@ -1,9 +1,19 @@
 """Run logger: stdout + append-only file under log_root
-(reference: main_distributed.py:304-306, rank-0 gated at call sites)."""
+(reference: main_distributed.py:304-306, rank-0 gated at call sites).
+
+The file handle is opened ONCE, line-buffered, and flushed per line —
+the original open-per-``log()`` cost a full open/write/close syscall
+round-trip on every display line (and on every decode-failure message
+arriving from reader threads).  ``log_event`` appends structured JSONL
+alongside the text log (``<run>.jsonl``) for machine consumers; the
+richer span/event stream lives in obs/spans.py (RUN_EVENTS.jsonl).
+"""
 
 from __future__ import annotations
 
+import json
 import os
+import threading
 import time
 
 
@@ -11,15 +21,53 @@ class RunLogger:
     def __init__(self, log_root: str, run_name: str = "", enabled: bool = True):
         self.enabled = enabled
         self.path = None
+        self.events_path = None
+        self._fh = None
+        self._events_fh = None
+        self._closed = False
+        self._lock = threading.Lock()
         if enabled and log_root:
             os.makedirs(log_root, exist_ok=True)
-            self.path = os.path.join(log_root, (run_name or "run") + ".log")
+            base = os.path.join(log_root, run_name or "run")
+            self.path = base + ".log"
+            self.events_path = base + ".jsonl"
+            self._fh = open(self.path, "a", buffering=1)
 
     def log(self, message: str) -> None:
         if not self.enabled:
             return
         line = f"[{time.strftime('%H:%M:%S')}] {message}"
         print(line, flush=True)
-        if self.path:
-            with open(self.path, "a") as f:
-                f.write(line + "\n")
+        with self._lock:            # reader threads log decode failures;
+            if self._fh is not None:  # handle check INSIDE the lock — a
+                # racing close() between check and write would otherwise
+                # deref None / a closed file
+                self._fh.write(line + "\n")
+
+    def log_event(self, event: dict) -> None:
+        """Append one structured record to the JSONL twin of the text
+        log (opened lazily — most runs never call this).  A no-op after
+        ``close()``, like ``log``: close is terminal, not a flush."""
+        if not self.enabled or not self.events_path or self._closed:
+            return
+        with self._lock:
+            if self._closed:
+                return
+            if self._events_fh is None:
+                self._events_fh = open(self.events_path, "a", buffering=1)
+            self._events_fh.write(json.dumps(event) + "\n")
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            for fh in (self._fh, self._events_fh):
+                if fh is not None:
+                    fh.close()
+            self._fh = None
+            self._events_fh = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # graftlint: disable=GL007(interpreter-teardown finalizer: close is best-effort, raising only makes unraisable-exception noise)
+            pass

@@ -1,0 +1,273 @@
+#!/usr/bin/env python
+"""Observability report + regression gate over the unified artifacts.
+
+One tool reads everything the obs subsystem emits (OBSERVABILITY.md):
+
+- ``RUN_EVENTS.jsonl`` span/event streams (train runs, obs/spans.py);
+- ``milnce.obs/v1`` snapshot documents — serve_bench reports
+  (``SERVE_BENCH_*.json``), raw registry snapshots, train bench records
+  (the ``schema``/``kind`` keys discriminate producers).
+
+Usage::
+
+    python scripts/obs_report.py RUN_EVENTS.jsonl            # summarize
+    python scripts/obs_report.py SERVE_BENCH_tiny_closed.json
+    python scripts/obs_report.py --check CURRENT --baseline BASELINE \
+        [--tolerance 0.10]                                   # CI gate
+
+The gate compares the artifacts' *gate metrics* (step-time p50/p99 from
+a span stream; latency p50/p99 + QPS from a serve_bench report;
+clips/sec from a train bench record) against a committed baseline and
+exits nonzero when any drifts more than ``--tolerance`` (default 10%)
+in the bad direction — wired next to ``graft_lint.py --check`` in the
+README verify recipe.  Drift in the *good* direction never fails: the
+gate is a regression fence, not a pin.
+
+stdlib-only, no jax import: the gate must cost milliseconds in CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from milnce_tpu.obs.export import SNAPSHOT_SCHEMA  # noqa: E402  (jax-free)
+
+# gate metric name -> direction ("lower" = lower is better)
+GATE_DIRECTIONS = {
+    "step_ms_p50": "lower",
+    "step_ms_p99": "lower",
+    "latency_ms_p50": "lower",
+    "latency_ms_p99": "lower",
+    "qps": "higher",
+    "clips_per_sec_per_chip": "higher",
+}
+
+
+def _percentile(sorted_vals: list, q: float) -> float:
+    """Linear-interpolated percentile over an already-sorted list."""
+    if not sorted_vals:
+        return float("nan")
+    if len(sorted_vals) == 1:
+        return float(sorted_vals[0])
+    pos = (q / 100.0) * (len(sorted_vals) - 1)
+    lo = int(pos)
+    frac = pos - lo
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    return float(sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac)
+
+
+# ---------------------------------------------------------------------------
+# loading
+# ---------------------------------------------------------------------------
+
+def load_artifact(path: str) -> dict:
+    """-> ``{"format": "events", "records": [...]}`` for a JSONL stream,
+    or ``{"format": "snapshot", "doc": {...}}`` for a schema'd JSON
+    document.  Unversioned JSON is an error, not a guess — the whole
+    point of the shared schema is that this tool never sniffs."""
+    with open(path) as fh:
+        head = fh.read(1)
+        fh.seek(0)
+        if not head:
+            raise ValueError(f"{path}: empty artifact")
+        if path.endswith(".jsonl"):
+            records = [json.loads(line) for line in fh if line.strip()]
+            return {"format": "events", "records": records, "path": path}
+        doc = json.load(fh)
+    schema = doc.get("schema")
+    if schema != SNAPSHOT_SCHEMA:
+        raise ValueError(
+            f"{path}: schema {schema!r} is not {SNAPSHOT_SCHEMA!r} — "
+            "regenerate the artifact with the current tools "
+            "(OBSERVABILITY.md 'Snapshot schema')")
+    return {"format": "snapshot", "doc": doc, "path": path}
+
+
+# ---------------------------------------------------------------------------
+# summaries
+# ---------------------------------------------------------------------------
+
+def summarize_events(records: list) -> dict:
+    """Per-name span duration stats + event counts."""
+    spans: dict[str, list] = {}
+    span_errors: dict[str, int] = {}
+    events: dict[str, int] = {}
+    for rec in records:
+        name = rec.get("name", "?")
+        if rec.get("kind") == "span":
+            spans.setdefault(name, []).append(float(rec.get("dur_ms", 0.0)))
+            if "error" in rec:
+                span_errors[name] = span_errors.get(name, 0) + 1
+        elif rec.get("kind") == "event":
+            events[name] = events.get(name, 0) + 1
+    span_stats = {}
+    for name, durs in spans.items():
+        durs = sorted(durs)
+        span_stats[name] = {
+            "count": len(durs),
+            "total_ms": round(sum(durs), 3),
+            "mean_ms": round(sum(durs) / len(durs), 4),
+            "p50_ms": round(_percentile(durs, 50), 4),
+            "p99_ms": round(_percentile(durs, 99), 4),
+            "errors": span_errors.get(name, 0),
+        }
+    return {"spans": span_stats, "events": events}
+
+
+def gate_metrics(artifact: dict) -> dict[str, float]:
+    """The comparable numbers an artifact contributes to the gate."""
+    out: dict[str, float] = {}
+    if artifact["format"] == "events":
+        stats = summarize_events(artifact["records"])["spans"].get("step")
+        if stats:
+            out["step_ms_p50"] = stats["p50_ms"]
+            out["step_ms_p99"] = stats["p99_ms"]
+        return out
+    doc = artifact["doc"]
+    lat = doc.get("latency_ms") or {}
+    for src, dst in (("p50", "latency_ms_p50"), ("p99", "latency_ms_p99")):
+        v = lat.get(src)
+        if isinstance(v, (int, float)):
+            out[dst] = float(v)
+    for key in ("qps", "clips_per_sec_per_chip"):
+        v = doc.get(key)
+        if isinstance(v, (int, float)):
+            out[key] = float(v)
+    if "value" in doc and doc.get("unit") == "clips/sec/chip":
+        out["clips_per_sec_per_chip"] = float(doc["value"])
+    return out
+
+
+def render_summary(artifact: dict) -> str:
+    lines = [f"artifact: {artifact['path']} ({artifact['format']})"]
+    if artifact["format"] == "events":
+        s = summarize_events(artifact["records"])
+        lines.append(f"  records: {len(artifact['records'])}")
+        if s["spans"]:
+            lines.append("  spans (name count mean/p50/p99 ms errors):")
+            for name in sorted(s["spans"]):
+                st = s["spans"][name]
+                lines.append(
+                    f"    {name:<16} {st['count']:>6}  "
+                    f"{st['mean_ms']:>10.3f} {st['p50_ms']:>10.3f} "
+                    f"{st['p99_ms']:>10.3f}  {st['errors']}")
+        if s["events"]:
+            lines.append("  events: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(s["events"].items())))
+    else:
+        doc = artifact["doc"]
+        lines.append(f"  kind: {doc.get('kind')}  schema: {doc['schema']}")
+        for k, v in sorted(gate_metrics(artifact).items()):
+            lines.append(f"  {k}: {v}")
+        metrics = doc.get("metrics") or {}
+        if metrics:
+            lines.append(f"  registry families: {len(metrics)}")
+            for name in sorted(metrics):
+                fam = metrics[name]
+                if fam["type"] == "histogram":
+                    tot = sum(v.get("count", 0) for v in fam["values"])
+                    lines.append(f"    {name} (histogram): {tot} samples")
+                else:
+                    vals = ", ".join(
+                        (("{" + ",".join(f"{lk}={lv}" for lk, lv in
+                                         v["labels"].items()) + "}")
+                         if v["labels"] else "") + f"{v['value']:g}"
+                        for v in fam["values"][:6])
+                    lines.append(f"    {name} ({fam['type']}): {vals}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# the gate
+# ---------------------------------------------------------------------------
+
+def check(current: dict, baseline: dict, tolerance: float) -> tuple[bool,
+                                                                    str]:
+    """-> (ok, report).  Fails on any shared gate metric drifting more
+    than ``tolerance`` in its bad direction; errors (ok=False) when the
+    artifacts share no gate metrics at all — a gate that silently
+    compares nothing is worse than no gate."""
+    cur, base = gate_metrics(current), gate_metrics(baseline)
+    shared = sorted(set(cur) & set(base))
+    if not shared:
+        return False, (
+            f"no shared gate metrics between {current['path']} "
+            f"({sorted(cur) or 'none'}) and baseline {baseline['path']} "
+            f"({sorted(base) or 'none'}) — artifacts are not comparable")
+    lines = [f"gate: {current['path']} vs baseline {baseline['path']} "
+             f"(tolerance {tolerance:.0%})"]
+    ok = True
+    compared = 0
+    for name in shared:
+        b, c = base[name], cur[name]
+        if b == 0:
+            lines.append(f"  [skip] {name}: baseline is 0")
+            continue
+        compared += 1
+        drift = (c - b) / b
+        bad = (drift > tolerance if GATE_DIRECTIONS[name] == "lower"
+               else drift < -tolerance)
+        ok = ok and not bad
+        lines.append(f"  [{'FAIL' if bad else 'ok'}] {name}: "
+                     f"{b:g} -> {c:g} ({drift:+.1%}, "
+                     f"{GATE_DIRECTIONS[name]} is better)")
+    if compared == 0:
+        # every shared metric got skipped (all-zero baseline, e.g. a
+        # bench error-path record committed by mistake) — a gate that
+        # compared nothing must not pass
+        lines.append("  FAIL: every shared gate metric has a zero "
+                     "baseline — nothing was compared; fix the baseline "
+                     "artifact")
+        return False, "\n".join(lines)
+    return ok, "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="observability summarizer + regression gate "
+                    "(scripts/obs_report.py)")
+    ap.add_argument("artifact",
+                    help="RUN_EVENTS.jsonl or a milnce.obs/v1 JSON doc")
+    ap.add_argument("--check", action="store_true",
+                    help="gate the artifact against --baseline; exit 1 "
+                         "on regression")
+    ap.add_argument("--baseline", default="",
+                    help="committed baseline artifact to gate against")
+    ap.add_argument("--tolerance", type=float, default=0.10,
+                    help="allowed bad-direction drift fraction "
+                         "(default 0.10)")
+    args = ap.parse_args(argv)
+
+    try:
+        current = load_artifact(args.artifact)
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"obs_report: cannot read {args.artifact}: {exc}",
+              file=sys.stderr)
+        return 2
+
+    if not args.check:
+        print(render_summary(current))
+        return 0
+
+    if not args.baseline:
+        print("obs_report: --check requires --baseline", file=sys.stderr)
+        return 2
+    try:
+        baseline = load_artifact(args.baseline)
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"obs_report: cannot read baseline {args.baseline}: {exc}",
+              file=sys.stderr)
+        return 2
+    ok, report = check(current, baseline, args.tolerance)
+    print(report)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

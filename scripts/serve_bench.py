@@ -267,7 +267,7 @@ def main(argv=None) -> int:
     lat_ms = np.asarray(sorted(lats), np.float64) * 1e3
     pct = (lambda q: float(np.percentile(lat_ms, q))) if len(lat_ms) else (
         lambda q: float("nan"))
-    report = {
+    extra = {
         "generator": "scripts/serve_bench.py",
         "mode": args.mode,
         "backend": args.backend,
@@ -294,6 +294,14 @@ def main(argv=None) -> int:
         "engine": health["engine"],
         "index": health["index"],
     }
+    # the versioned obs snapshot (OBSERVABILITY.md): registry metrics
+    # (request counters, per-bucket occupancy, collect-time gauges) plus
+    # the report keys above as extras — SERVE_BENCH_*.json and train
+    # bench records are now diffable by one tool (scripts/obs_report.py)
+    from milnce_tpu.obs import export as obs_export
+
+    report = obs_export.snapshot(service.registry, kind="serve_bench",
+                                 extra=extra)
     out = args.out or os.path.join(
         _REPO, f"SERVE_BENCH_{args.preset}_{args.mode}.json")
     with open(out, "w") as fh:

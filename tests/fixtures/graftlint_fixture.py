@@ -110,6 +110,28 @@ def broad_but_recorded(f, log):
         return None
 
 
+# ---- GL008 obs-under-trace ---------------------------------------------
+
+class _Meter:                           # registry-metric stand-in
+    def inc(self):
+        pass
+
+
+METER = _Meter()
+
+
+@jax.jit
+def traced_obs(x):
+    METER.inc()                     # GL008: host telemetry under trace
+    return x
+
+
+@jax.jit
+def traced_obs_suppressed(x):
+    METER.inc()  # graftlint: disable=GL008(fixture: the audited suppressed occurrence)
+    return x
+
+
 # ---- GL000 bad-suppression ---------------------------------------------
 
 x_no_reason = 1  # graftlint: disable=GL001

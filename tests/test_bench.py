@@ -47,6 +47,11 @@ class TestMakeRecord:
 
     def test_schema_and_anchor(self):
         rec = bench._make_record(self.BEST, 16, 224, True, "TPU v5 lite")
+        # ISSUE 5: the record is a milnce.obs/v1 document (diffable by
+        # scripts/obs_report.py alongside serve benches)
+        from milnce_tpu.obs.export import SNAPSHOT_SCHEMA
+        assert rec["schema"] == SNAPSHOT_SCHEMA
+        assert rec["kind"] == "train_bench"
         assert rec["unit"] == "clips/sec/chip"
         assert rec["value"] == 100.0
         assert rec["on_tpu"] is True

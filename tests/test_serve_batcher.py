@@ -186,3 +186,16 @@ def test_stats_shape():
     assert s["deadline_expired"] == 0 and s["batch_errors"] == 0
     assert s["occupancy"]["4"]["mean_fill"] == pytest.approx(0.25)
     b.close()
+
+def test_injected_recorder_receives_flush_spans():
+    # an owner that isolates its span stream (recorder=...) must get the
+    # flush spans there — not on the process-default recorder, which a
+    # co-resident train run can swap out via spans.install()
+    from milnce_tpu.obs.spans import SpanRecorder
+
+    rec = SpanRecorder()
+    b = _mk(_FakeEngine(), max_delay_ms=20, recorder=rec)
+    b.submit(np.ones((3,), np.float32)).result(timeout=5)
+    b.close()
+    spans = [r for r in rec.tail() if r.get("name") == "batcher.flush"]
+    assert len(spans) == 1 and spans[0]["rows"] == 1

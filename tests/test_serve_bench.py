@@ -32,6 +32,16 @@ def test_cpu_smoke_emits_valid_report(tmp_path):
     report = json.loads(out.read_text())
 
     assert report["generator"] == "scripts/serve_bench.py"
+    # ISSUE 5: the report is a versioned obs snapshot — one schema for
+    # serve benches, train benches, and registry dumps, so
+    # scripts/obs_report.py can summarize and gate any of them
+    assert report["schema"] == "milnce.obs/v1"
+    assert report["kind"] == "serve_bench"
+    for family in ("milnce_serve_requests_total",
+                   "milnce_serve_batch_occupancy",
+                   "milnce_serve_cache_hit_rate",
+                   "milnce_serve_engine_recompiles"):
+        assert family in report["metrics"], f"{family} missing"
     assert report["requests"] > 0 and report["qps"] > 0
     assert report["errors"] == 0 and report["deadline_expired"] == 0
     # latency percentiles present, ordered, finite

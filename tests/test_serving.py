@@ -347,3 +347,47 @@ class TestHTTP:
         with pytest.raises(urllib.error.HTTPError) as exc:
             _post(f"{http_server}/v1/nope", {})
         assert exc.value.code == 404
+
+    def test_metrics_prometheus_exposition(self, stack, http_server):
+        """ISSUE 5 acceptance: GET /metrics on a live service returns
+        valid Prometheus text — request counters, batcher occupancy
+        histogram, cache hit rate, recompile gauge."""
+        # guarantee traffic has flowed through the request path
+        stack["service"].query_ids(
+            np.zeros((1, stack["service"].engine.text_words), np.int32))
+        with urllib.request.urlopen(f"{http_server}/metrics",
+                                    timeout=30) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith(
+                "text/plain; version=0.0.4")
+            text = r.read().decode()
+        assert "# TYPE milnce_serve_requests_total counter" in text
+        assert "# TYPE milnce_serve_batch_occupancy histogram" in text
+        assert 'milnce_serve_batch_occupancy_bucket{batcher="text",' in text
+        assert "# TYPE milnce_serve_cache_hit_rate gauge" in text
+        assert "milnce_serve_engine_recompiles 0" in text
+        assert "milnce_serve_queries_total" in text
+        # /healthz keys stay backward-compatible AND agree with the
+        # exposition (one source of truth for both surfaces)
+        health = stack["service"].health()
+        assert (f"milnce_serve_queries_total {health['queries']}"
+                in text)
+        assert (f"milnce_serve_requests_total{{batcher=\"text\"}} "
+                f"{health['batcher']['requests']}" in text)
+
+    def test_obs_events_ring_over_http(self, stack, http_server):
+        stack["service"].query_ids(
+            np.zeros((1, stack["service"].engine.text_words), np.int32))
+        with urllib.request.urlopen(f"{http_server}/obs/events?n=50",
+                                    timeout=30) as r:
+            body = json.loads(r.read())
+        events = body["events"]
+        assert isinstance(events, list) and len(events) <= 50
+        # the batcher worker's flush spans land on the process recorder
+        assert any(e.get("name") == "batcher.flush" for e in events)
+
+    def test_obs_events_bad_n_is_400(self, http_server):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{http_server}/obs/events?n=abc",
+                                   timeout=30)
+        assert exc.value.code == 400

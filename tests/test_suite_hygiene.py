@@ -200,6 +200,28 @@ def test_serving_gates_exist_and_stay_tier1():
             f"are the request-path regression fence): {fname}::{slow}")
 
 
+# observability gates (ISSUE 5): the obs subsystem's tests — registry
+# thread-safety with exact counts, the Prometheus exposition golden, the
+# obs_report regression gate, and the instrumented-train-run event
+# stream — are the telemetry regression fence.  Same rule as the
+# analysis/chaos/serving gates: tier-1, never @slow, never vanished.
+_OBS_GATES = ("test_obs.py",)
+
+
+def test_obs_gates_exist_and_stay_tier1():
+    for fname in _OBS_GATES:
+        path = os.path.join(_TESTS, fname)
+        assert os.path.exists(path), f"obs gate {fname} is missing"
+        src = open(path).read()
+        tests = list(_iter_tests(ast.parse(src)))
+        assert tests, f"{fname} defines no tests"
+        slow = [node.name for node, class_slow in tests
+                if _is_slow_marked(node, class_slow)]
+        assert not slow, (
+            "obs tests must be tier-1/CPU-safe, never @slow (they are "
+            f"the telemetry regression fence): {fname}::{slow}")
+
+
 def test_fast_child_exemptions_stay_real():
     """Every _FAST_CHILD_EXEMPT entry must name a test that still
     exists — a stale exemption is a hole the audit thinks it covers."""

@@ -30,7 +30,7 @@ def test_all_registered_entry_invariants_hold():
     # pinned index collectives)
     entries = {r.entry for r in results}
     assert {"train_step_milnce", "train_step_milnce_guarded",
-            "train_step_sdtw3",
+            "train_step_milnce_instrumented", "train_step_sdtw3",
             "grad_cache_step_milnce", "video_embed", "text_embed",
             "softdtw_scan_grad", "param_treedef",
             "serve_embed_ladder", "serve_text_embed", "serve_video_embed",
@@ -38,9 +38,16 @@ def test_all_registered_entry_invariants_hold():
     # the double-call recompile detector ran on every executable entry
     recompiled = {r.entry for r in results if r.check == "recompile"}
     assert {"train_step_milnce", "train_step_milnce_guarded",
+            "train_step_milnce_instrumented",
             "video_embed", "text_embed",
             "softdtw_scan_grad", "serve_embed_ladder",
             "serve_index_topk"} <= recompiled
+    # ISSUE 5 acceptance: the instrumented step executed under the
+    # steady-state transfer guard and its pins match the plain step's
+    checks = {(r.entry, r.check) for r in results}
+    assert ("train_step_milnce_instrumented", "transfer-guard") in checks
+    assert ("train_step_milnce_instrumented",
+            "identical-to-uninstrumented") in checks
 
 
 def test_f64_detector_catches_planted_upcast():

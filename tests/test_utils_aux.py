@@ -1,8 +1,92 @@
-"""Aux utilities: asset converter CLI, multi-host env detection, and the
-training divergence guard."""
+"""Aux utilities: asset converter CLI, multi-host env detection, the
+training divergence guard, and the RunLogger (ISSUE 5 satellite)."""
+
+import json
+import threading
 
 import numpy as np
 import pytest
+
+
+class TestRunLogger:
+    def test_single_persistent_handle_flushed_per_line(self, tmp_path):
+        """The handle is opened ONCE (the old open-per-log() cost a full
+        syscall round-trip per display line) and line-buffered: every
+        line is on disk the moment log() returns."""
+        from milnce_tpu.utils.logging import RunLogger
+
+        logger = RunLogger(str(tmp_path), "run1")
+        fh = logger._fh
+        logger.log("first")
+        assert logger._fh is fh, "log() must not reopen the file"
+        # flushed without close: a crash loses at most the current line
+        assert "first" in open(logger.path).read()
+        logger.log("second")
+        assert logger._fh is fh
+        lines = open(logger.path).read().splitlines()
+        assert len(lines) == 2 and lines[1].endswith("second")
+        logger.close()
+        assert logger._fh is None
+        logger.close()                        # idempotent
+
+    def test_log_event_appends_jsonl_twin(self, tmp_path):
+        from milnce_tpu.utils.logging import RunLogger
+
+        logger = RunLogger(str(tmp_path), "run1")
+        logger.log_event({"step": 1, "loss": 0.5})
+        logger.log_event({"step": 2, "loss": 0.25})
+        logger.close()
+        records = [json.loads(l) for l in open(logger.events_path)]
+        assert records == [{"step": 1, "loss": 0.5},
+                           {"step": 2, "loss": 0.25}]
+
+    def test_close_is_terminal_for_both_streams(self, tmp_path):
+        # close() must not be resurrectable: a late log()/log_event()
+        # from a thread holding a stale reference is a no-op, never a
+        # silently reopened handle
+        from milnce_tpu.utils.logging import RunLogger
+
+        logger = RunLogger(str(tmp_path), "run1")
+        logger.log("before")
+        logger.log_event({"step": 1})
+        logger.close()
+        logger.log("after")
+        logger.log_event({"step": 2})
+        assert open(logger.path).read().count("\n") == 1
+        records = [json.loads(l) for l in open(logger.events_path)]
+        assert records == [{"step": 1}]
+
+    def test_disabled_logger_writes_nothing(self, tmp_path):
+        from milnce_tpu.utils.logging import RunLogger
+
+        logger = RunLogger(str(tmp_path), "run1", enabled=False)
+        logger.log("x")
+        logger.log_event({"a": 1})
+        logger.close()
+        assert logger.path is None and logger.events_path is None
+
+    def test_concurrent_writers_interleave_whole_lines(self, tmp_path):
+        """Reader threads log decode failures while the loop logs the
+        display line — lines must never shear."""
+        from milnce_tpu.utils.logging import RunLogger
+
+        logger = RunLogger(str(tmp_path), "run1")
+        n, k = 4, 50
+
+        def worker(tid):
+            for i in range(k):
+                logger.log(f"t{tid}:{i}:{'x' * 64}")
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        logger.close()
+        lines = open(logger.path).read().splitlines()
+        assert len(lines) == n * k
+        assert all(line.endswith("x" * 64) for line in lines)
 
 
 class TestAssetsCLI:
