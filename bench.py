@@ -18,6 +18,16 @@ established"); the headline metric is the best clips/sec/chip across the
 published GPU input config, /root/reference/README.md:114-129).
 ``vs_baseline`` is measured against BASELINE_THROUGHPUT once a first
 real-TPU number exists in round history; 1.0 until then.
+
+Mesh sweep axis (ISSUE 6): ``MILNCE_BENCH_MESH=data,model[=N]`` runs
+the whole sweep on the 2-D FSDP grid (state sharded per
+parallel/sharding_map.py; batch over both axes); by default a
+``mesh_2d`` comparison row is measured at the winning 1-D operating
+point.  Every record carries its mesh shape and sharding-map hash so
+``obs_report --check`` compares like with like, and a 2-D row whose
+map shards nothing is REFUSED rather than measured as fake FSDP.
+Related knobs: MILNCE_BENCH_FSDP_MIN (threshold override),
+MILNCE_BENCH_MESH_2D=0 (skip the comparison row).
 """
 
 from __future__ import annotations
@@ -197,11 +207,25 @@ def _step_flops(step_fn, args):
     return None
 
 
+def _parse_mesh_spec(spec: str):
+    """``--mesh``/MILNCE_BENCH_MESH grammar: '' (1-D data mesh) or
+    'data,model[=N]' (2-D FSDP grid, model axis N wide — default 2).
+    Mirrors config's fail-at-parse-time discipline."""
+    if not spec:
+        return None, 1
+    names = [p for p in spec.split(",") if p]
+    if len(names) != 2 or names[0] != "data":
+        raise ValueError(f"mesh spec {spec!r}: expected 'data,model[=N]'")
+    axis, _, n = names[1].partition("=")
+    return axis, int(n) if n else 2
+
+
 def _bench_config(dtype: str, batch: int, frames: int, size: int,
                   words: int, k: int, remat: bool,
                   inner: int = 1, s2d: bool = False,
                   conv_impl: str = "native", conv_impl_map: str = "",
                   loss: str = "milnce", grad_accum: int = 1,
+                  mesh_spec: str = "",
                   peak: float | None = None, flops_hint: float | None = None):
     """Time the full train step at one operating point.
 
@@ -213,6 +237,12 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
     the Pallas kernel inside the full compiled train step.  FLOPs/MFU
     are reported for milnce only (the analytic model doesn't count the
     alignment DP).
+    ``mesh_spec`` ('data,model[=N]') runs the row on the 2-D FSDP grid:
+    state sharded per the sharding map, batch over both axes, the
+    record carrying mesh shape + map hash so ``obs_report`` can compare
+    1-D and 2-D runs.  A 2-D row whose map shards NOTHING is refused
+    (RuntimeError) — paying model-axis collectives for pure replication
+    must not masquerade as an FSDP measurement.
     Returns dict with clips/sec/chip (+flops) or raises on OOM."""
     import jax
     import jax.numpy as jnp
@@ -232,6 +262,13 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
     # per-stage overrides: inline spec or stage_probe --autotune artifact
     # path (config.parse_conv_impl_map handles both)
     cfg.model.conv_impl_map = conv_impl_map
+    model_axis, model_n = _parse_mesh_spec(mesh_spec)
+    if model_axis:
+        cfg.parallel.model_axis = model_axis
+        cfg.parallel.model_parallel_size = model_n
+        min_env = os.environ.get("MILNCE_BENCH_FSDP_MIN")
+        if min_env:
+            cfg.parallel.fsdp_min_size = int(min_env)
     model = build_model(cfg.model)
     mesh = build_mesh(cfg.parallel)
 
@@ -241,6 +278,48 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
         cfg.loss.sdtw_backend = "auto"   # Pallas where the measured
         loss_cfg = cfg.loss              # crossover says it wins
     optimizer = build_optimizer(cfg.optim, build_schedule(cfg.optim, 1000))
+
+    # Everything below runs ON DEVICE in three jitted programs.  The
+    # obvious host-side version (eager model.init + optimizer.init +
+    # device_put of host-generated arrays) issues hundreds of tiny
+    # dispatches and ships ~0.1-1 GB of synthetic video over the wire —
+    # over the remote TPU tunnel (multi-second per-dispatch latency,
+    # limited bandwidth) that took LONGER than the measurement itself.
+    repl = replicated(mesh)
+    batch_axes = ((cfg.parallel.data_axis, model_axis) if model_axis
+                  else cfg.parallel.data_axis)
+    data_sh = batch_sharding(mesh, batch_axes)
+
+    def init_state(key):
+        variables = model.init(
+            key, jnp.zeros((2, frames, size, size, 3), jnp.float32),
+            jnp.zeros((2 * k, words), jnp.int32))
+        return create_train_state(variables, optimizer)
+
+    state = jax.jit(init_state, out_shardings=repl)(jax.random.PRNGKey(0))
+
+    state_specs = None
+    mesh_fields = {
+        "mesh": "x".join(str(mesh.shape[a]) for a in mesh.axis_names)
+                + f" ({','.join(mesh.axis_names)})"}
+    if model_axis:
+        from milnce_tpu.parallel.sharding_map import shard_and_place_state
+
+        placement = shard_and_place_state(
+            state, mesh, model_axis, min_size=cfg.parallel.fsdp_min_size,
+            spec=cfg.parallel.sharding_map)
+        if placement.n_sharded == 0:
+            # refuse, don't measure: a 2-D row paying model-axis
+            # collectives for pure replication is not an FSDP data point
+            raise RuntimeError(
+                "2-D mesh row with a sharding map that shards NOTHING "
+                f"(threshold {cfg.parallel.fsdp_min_size} elements) — "
+                "lower MILNCE_BENCH_FSDP_MIN or fix the map")
+        state_specs = placement.specs
+        mesh_fields["sharding_map_hash"] = placement.hash
+        mesh_fields["params_sharded"] = placement.n_sharded
+        state = placement.state
+
     if grad_accum > 1:
         # the two-pass embedding-cache program (the 8192-global-batch
         # recipe's step): ``batch`` clips consumed per update via
@@ -251,27 +330,14 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
         from milnce_tpu.train.step import make_grad_cache_step
 
         step_fn = make_grad_cache_step(model, optimizer, mesh, grad_accum,
-                                       donate=False, loss_cfg=loss_cfg)
+                                       donate=False, loss_cfg=loss_cfg,
+                                       state_specs=state_specs,
+                                       model_axis=model_axis)
     else:
         step_fn = make_train_step(model, optimizer, mesh, donate=False,
-                                  inner_steps=inner, loss_cfg=loss_cfg)
-
-    # Everything below runs ON DEVICE in three jitted programs.  The
-    # obvious host-side version (eager model.init + optimizer.init +
-    # device_put of host-generated arrays) issues hundreds of tiny
-    # dispatches and ships ~0.1-1 GB of synthetic video over the wire —
-    # over the remote TPU tunnel (multi-second per-dispatch latency,
-    # limited bandwidth) that took LONGER than the measurement itself.
-    repl = replicated(mesh)
-    data_sh = batch_sharding(mesh, cfg.parallel.data_axis)
-
-    def init_state(key):
-        variables = model.init(
-            key, jnp.zeros((2, frames, size, size, 3), jnp.float32),
-            jnp.zeros((2 * k, words), jnp.int32))
-        return create_train_state(variables, optimizer)
-
-    state = jax.jit(init_state, out_shardings=repl)(jax.random.PRNGKey(0))
+                                  inner_steps=inner, loss_cfg=loss_cfg,
+                                  state_specs=state_specs,
+                                  model_axis=model_axis)
 
     def make_inputs(key):
         kv, kt = jax.random.split(key)
@@ -300,7 +366,9 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
         flops, flops_source = flops_hint, "hint"
     else:
         single = (step_fn if inner == 1 else
-                  make_train_step(model, optimizer, mesh, donate=False))
+                  make_train_step(model, optimizer, mesh, donate=False,
+                                  state_specs=state_specs,
+                                  model_axis=model_axis))
         flops = _step_flops(single, (state, video_d, text_d, start_d))
         if flops is not None:
             flops_source = "xla"
@@ -398,6 +466,7 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
         "loss": loss,
         "grad_accum": grad_accum,
         "inner": inner,
+        **mesh_fields,
         "step_ms": round(dt / inner * 1e3, 2),
         "clips_per_sec_per_chip": round(batch * inner / dt / n_chips, 3),
         "flops_per_step": flops,
@@ -552,6 +621,12 @@ def _make_record(best, frames, size, on_tpu, kind):
     }
     if "mfu" in best:
         out["mfu"] = best["mfu"]
+    # mesh layout + sharding-map identity (ISSUE 6): obs_report --check
+    # can only compare 1-D and 2-D runs if the record says which layout
+    # (and which map) produced the number
+    for key in ("mesh", "sharding_map_hash", "params_sharded"):
+        if best.get(key) is not None:
+            out[key] = best[key]
     if not on_tpu:
         # a fallback record must point at the real data: the recorded TPU
         # operating point lives in BENCH_NOTES.md
@@ -590,6 +665,11 @@ def run_bench(on_tpu: bool, info: dict):
     impl_map = os.environ.get("MILNCE_BENCH_IMPL_MAP", "")
     if impl_map and "=" not in impl_map and not os.path.isabs(impl_map):
         impl_map = os.path.join(_REPO, impl_map)
+    # mesh layout for the sweep rows: '' = 1-D data mesh (default),
+    # 'data,model[=N]' runs the WHOLE sweep on the 2-D FSDP grid; with
+    # the default 1-D sweep a mesh_2d comparison row is auto-measured at
+    # the winning operating point (opt out: MILNCE_BENCH_MESH_2D=0)
+    mesh_spec = os.environ.get("MILNCE_BENCH_MESH", "")
     if on_tpu:
         frames, size, words, k = 16, 224, 20, 5
         # differenced W(k2)-W(k1) timing cancels dispatch latency, so the
@@ -626,7 +706,8 @@ def run_bench(on_tpu: bool, info: dict):
         return linear * batch / b0 + milnce_logits_flops(batch, k)
 
     def measure(dtype, batch, remat, s2d, conv_impl, loss="milnce",
-                grad_accum=1, timeout_s=None, conv_impl_map=None):
+                grad_accum=1, timeout_s=None, conv_impl_map=None,
+                mesh=None):
         return _run_config(
             timeout_s=timeout_s or cfg_timeout,
             platform_pin=None if on_tpu else "cpu",
@@ -635,7 +716,8 @@ def run_bench(on_tpu: bool, info: dict):
             inner=1 if grad_accum > 1 else inner, s2d=s2d,
             conv_impl=conv_impl,
             conv_impl_map=impl_map if conv_impl_map is None else conv_impl_map,
-            loss=loss, grad_accum=grad_accum, peak=peak,
+            loss=loss, grad_accum=grad_accum,
+            mesh_spec=mesh_spec if mesh is None else mesh, peak=peak,
             flops_hint=None if grad_accum > 1
             else hint(dtype, remat, s2d, batch))
 
@@ -784,10 +866,22 @@ def run_bench(on_tpu: bool, info: dict):
     if on_tpu and os.environ.get("MILNCE_BENCH_SDTW") != "0":
         extra_row("sdtw_3", loss="sdtw_3", s2d=False, conv_impl="native",
                   conv_impl_map="")
+    # 2-D mesh row: the FSDP (data, model) grid at the winning operating
+    # point — mesh shape + sharding-map hash land in the record so
+    # obs_report can diff it against the 1-D rows (opt out:
+    # MILNCE_BENCH_MESH_2D=0; a sweep already pinned to a 2-D mesh via
+    # MILNCE_BENCH_MESH measures nothing extra).
+    if (on_tpu and not mesh_spec
+            and os.environ.get("MILNCE_BENCH_MESH_2D") != "0"):
+        extra_row("mesh_2d", mesh="data,model", s2d=False,
+                  conv_impl="native", conv_impl_map="")
     # North-star recipe row: the per-chip slice of the 8192-global-batch
     # training step — 8 embedding-cache microbatches of the winning batch
     # in ONE update (BASELINE.md HMDB-53.1 recipe; memory- and
-    # equivalence-proven in tests, measured here).  Bigger compile + 8x
+    # equivalence-proven in tests, measured here).  The row inherits the
+    # sweep's mesh and carries mesh/map-hash fields, so the ga=8
+    # operating point is comparable against BENCH_r05's 25%-down reading
+    # (and against a 2-D sweep) in obs_report.  Bigger compile + 8x
     # the work per dispatch -> double timeout (opt out:
     # MILNCE_BENCH_GRAD_ACCUM=0).
     if on_tpu and os.environ.get("MILNCE_BENCH_GRAD_ACCUM") != "0":
@@ -828,8 +922,8 @@ def _write_notes(results, best, kind, on_tpu, n_chips, truncated=False):
                  f"- chosen operating point: dtype={best['dtype']} "
                  f"batch={best['batch']} remat={best['remat']} -> "
                  f"{best['clips_per_sec_per_chip']} clips/sec/chip",
-                 "", "| dtype | batch | remat | s2d | conv | map | loss | ga | step_ms | clips/s/chip | MFU |",
-                 "|---|---|---|---|---|---|---|---|---|---|---|"]
+                 "", "| dtype | batch | remat | s2d | conv | map | loss | ga | mesh | step_ms | clips/s/chip | MFU |",
+                 "|---|---|---|---|---|---|---|---|---|---|---|---|"]
         for r in results:
             clips = str(r["clips_per_sec_per_chip"])
             if r.get("cliff_vs_smaller_batch"):
@@ -841,8 +935,16 @@ def _write_notes(results, best, kind, on_tpu, n_chips, truncated=False):
                          f"{'tuned' if r.get('impl_map') else '-'} | "
                          f"{r.get('loss', 'milnce')} | "
                          f"{r.get('grad_accum', 1)} | "
+                         f"{r.get('mesh', '-')} | "
                          f"{r['step_ms']} | {clips} | "
                          f"{r.get('mfu', '-')} |")
+        maps2d = sorted({r["sharding_map_hash"] for r in results
+                         if r.get("sharding_map_hash")})
+        if maps2d:
+            lines += ["", "2-D rows' sharding-map hash: "
+                      + "; ".join(f"`{h}`" for h in maps2d)
+                      + " (per-param layout: parallel/sharding_map.py "
+                      "describe_map; PERF.md '2-D mesh & sharding map')."]
         maps = sorted({r["impl_map"] for r in results if r.get("impl_map")})
         if maps:
             lines += ["", "Per-stage impl map for 'tuned' rows: "

@@ -57,6 +57,14 @@ _SYNC_NP = {"asarray", "array"}
 # is ubiquitous legitimate traced code.
 _OBS_RECORDING = {"span", "event", "observe", "inc", "dec", "log_event"}
 
+# Mesh-axis vocabulary GL009 always accepts: the repo's canonical axis
+# names (ParallelConfig.data_axis default + the model/FSDP axis the 2-D
+# mesh declares — config.py, parallel/mesh.py).  Axes declared by a
+# Mesh(...) construction or an axis_name(s)= kwarg in the SAME module
+# extend the set; anything else inside a with_sharding_constraint's
+# PartitionSpec is a phantom axis GSPMD would silently replicate.
+_CANONICAL_MESH_AXES = {"data", "model"}
+
 _ARRAY_ROOTS = {"np", "numpy", "jnp"}
 _FLOAT_DEFAULT_CTORS = {"zeros", "ones", "empty", "linspace", "eye"}
 _VALUE_CTORS = {"array", "asarray", "full"}
@@ -580,6 +588,62 @@ class _ModuleLint:
                            "record the bound exception, or add a reasoned "
                            "suppression")
 
+    # ---- GL009: phantom mesh axis in sharding constraints ----------------
+
+    def _declared_axes(self) -> set:
+        """Axis names this module legitimizes: the canonical set plus
+        string literals in ``Mesh(...)`` axis tuples and ``axis_name=``/
+        ``axis_names=``/``data_axis=``/``model_axis=`` kwargs — so a
+        module building its own exotic mesh lints clean against it."""
+        axes = set(_CANONICAL_MESH_AXES)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            terminal, _root = _terminal_and_root(node.func)
+            if terminal == "Mesh" and len(node.args) >= 2:
+                for sub in ast.walk(node.args[1]):
+                    if (isinstance(sub, ast.Constant)
+                            and isinstance(sub.value, str)):
+                        axes.add(sub.value)
+            for kw in node.keywords:
+                if kw.arg in ("axis_name", "axis_names", "data_axis",
+                              "model_axis"):
+                    for sub in ast.walk(kw.value):
+                        if (isinstance(sub, ast.Constant)
+                                and isinstance(sub.value, str)):
+                            axes.add(sub.value)
+        return axes
+
+    def check_sharding_axes(self) -> None:
+        axes = None                       # computed lazily: most modules
+        for node in ast.walk(self.tree):  # never constrain a sharding
+            if not isinstance(node, ast.Call):
+                continue
+            terminal, _root = _terminal_and_root(node.func)
+            if terminal != "with_sharding_constraint":
+                continue
+            if axes is None:
+                axes = self._declared_axes()
+            phantoms = []
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    t, _r = _terminal_and_root(sub.func)
+                    if t not in ("P", "PartitionSpec"):
+                        continue
+                    for leaf in ast.walk(sub):
+                        if (isinstance(leaf, ast.Constant)
+                                and isinstance(leaf.value, str)
+                                and leaf.value not in axes):
+                            phantoms.append(leaf.value)
+            if phantoms:
+                self._emit("GL009", node,
+                           f"with_sharding_constraint names axes "
+                           f"{sorted(set(phantoms))} that no mesh in scope "
+                           "declares — GSPMD silently replicates a phantom "
+                           "axis instead of erroring")
+
     # ---- driver ----------------------------------------------------------
 
     def run(self) -> list[Finding]:
@@ -589,6 +653,7 @@ class _ModuleLint:
         self.check_f64()
         self.check_timing()
         self.check_broad_except()
+        self.check_sharding_axes()
         return self.findings
 
 

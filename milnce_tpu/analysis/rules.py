@@ -152,6 +152,29 @@ _RULE_LIST = (
             "display-cadence fetch); genuinely trace-time-only setup gets "
             "# graftlint: disable=GL008(<why this is trace-time setup>)",
     ),
+    Rule(
+        id="GL009",
+        name="phantom-mesh-axis",
+        summary="with_sharding_constraint naming an axis absent from "
+                "the mesh",
+        rationale="A PartitionSpec axis name that no mesh declares does "
+                  "not error — GSPMD just treats the dimension as "
+                  "unconstrained and REPLICATES it.  A typo'd "
+                  "`P('modle')` in a traced step therefore traces, "
+                  "compiles, and runs... with every 'sharded' tensor "
+                  "silently full-size on every chip: the exact failure "
+                  "the 2-D FSDP path exists to avoid, invisible until "
+                  "someone reads an HBM profile.  (The runtime twin of "
+                  "this check is sharding_map.build_param_specs, which "
+                  "raises on a phantom model_axis.)",
+        example="x = jax.lax.with_sharding_constraint(x, P('modle'))",
+        fix="name only axes the mesh declares (this repo's canonical "
+            "axes are 'data' and 'model' — ParallelConfig; the lint "
+            "also accepts axes named by a Mesh(...) construction or an "
+            "axis_name= kwarg in the same module); a deliberate "
+            "foreign-mesh constraint gets "
+            "# graftlint: disable=GL009(<which mesh declares it>)",
+    ),
 )
 
 RULES: dict[str, Rule] = {r.id: r for r in _RULE_LIST}

@@ -34,9 +34,11 @@ from __future__ import annotations
 import functools
 from dataclasses import dataclass
 
-# Collective primitives whose per-step count we pin.
-COLLECTIVES = ("psum", "all_gather", "psum_scatter", "all_to_all",
-               "ppermute", "pbroadcast")
+# Collective primitives whose per-step count we pin.  ``reduce_scatter``
+# is what ``lax.psum_scatter`` lowers to on this jax — the FSDP grad
+# reduction's signature primitive (train/step.py _reduce_grads_2d).
+COLLECTIVES = ("psum", "all_gather", "psum_scatter", "reduce_scatter",
+               "all_to_all", "ppermute", "pbroadcast")
 
 # Pinned per-entry collective multisets for the 8-way data mesh (absent
 # primitive = expected 0).  Derived by tracing on the tiny entry config;
@@ -45,23 +47,56 @@ COLLECTIVES = ("psum", "all_gather", "psum_scatter", "all_to_all",
 #
 # Reading the milnce step: 2 all_gathers (video+text negatives ride ICI
 # once each); the 26 psums are the scalar loss reduction, the leaf-wise
-# grad psum, and the pmean-lowered BatchNorm stat merges.  sdtw_3 trades
-# one psum for a third all_gather (clip start-times feed the alignment).
+# grad psum, and the pmean-lowered BatchNorm stat merges; the 2
+# reduce_scatters are the AD transposes of the loss's embedding gathers
+# (every grad-bearing step has them — they were always in the program,
+# uncounted until ISSUE 6 added reduce_scatter to COLLECTIVES for the
+# FSDP entries, a conscious same-commit re-pin of every entry below).
+# sdtw_3 trades one psum for a third all_gather (clip start-times feed
+# the alignment; the start gather carries no gradient).
 EXPECTED_COLLECTIVES = {
-    "train_step_milnce": {"all_gather": 2, "psum": 26},
+    "train_step_milnce": {"all_gather": 2, "psum": 26,
+                          "reduce_scatter": 2},
     # the finite-update guard (ISSUE 3) must add NO collectives and no
     # host sync: its all-finite check runs on the already-psum'd
     # (replicated) grads and the skip is a jnp.where select — the pin
     # being IDENTICAL to the unguarded step is the invariant
-    "train_step_milnce_guarded": {"all_gather": 2, "psum": 26},
+    "train_step_milnce_guarded": {"all_gather": 2, "psum": 26,
+                                  "reduce_scatter": 2},
     # the obs span instrumentation (ISSUE 5) wraps the step DISPATCH in
     # a host-side recorder (train/loop.py `rec.span("step")`); it must
     # add NO collectives, no transfers, no sync — the pin being
     # IDENTICAL to the uninstrumented step is the tentpole invariant,
     # and the entry also EXECUTES it under transfer_guard("disallow")
-    "train_step_milnce_instrumented": {"all_gather": 2, "psum": 26},
-    "train_step_sdtw3": {"all_gather": 3, "psum": 25},
-    "grad_cache_step_milnce": {"all_gather": 2, "psum": 26},
+    "train_step_milnce_instrumented": {"all_gather": 2, "psum": 26,
+                                       "reduce_scatter": 2},
+    "train_step_sdtw3": {"all_gather": 3, "psum": 25,
+                         "reduce_scatter": 2},
+    "grad_cache_step_milnce": {"all_gather": 2, "psum": 26,
+                               "reduce_scatter": 2},
+    # 2-D (data, model) FSDP step on the 4x2 grid (ISSUE 6): 22
+    # all_gathers = 20 sharded-param materializations before the forward
+    # + the 2 loss negative gathers; 22 reduce_scatters = the 20
+    # model-axis halves of the per-leaf grad reduction (GSPMD's textbook
+    # gather/reduce-scatter pair, here explicit and therefore countable)
+    # + the 2 loss-gather transposes; the psums are the per-leaf
+    # data-axis grad reductions plus the replicated leaves' both-axes
+    # psums (overlap_grad_reduce=True emits them per leaf so the
+    # scheduler can overlap each with the backward) and the loss/BN
+    # reductions.  The guarded 2-D step adds exactly ONE psum — the
+    # model-axis finite-verdict reduction that keeps the skip decision
+    # uniform across model columns.  Counts are a function of the tiny
+    # entry model's leaf census under _FSDP_MIN_SIZE — a model/threshold
+    # change re-pins them in the same commit, like every other entry.
+    "train_step_milnce_2d": {"all_gather": 22, "psum": 78,
+                             "reduce_scatter": 22},
+    "train_step_milnce_2d_guarded": {"all_gather": 22, "psum": 79,
+                                     "reduce_scatter": 22},
+    # grad-cache on the 2-D mesh: identical communication to the
+    # single-pass 2-D step — the whole point of the once-per-step
+    # property (gather before pass 1, reduce after pass 2, NOTHING per
+    # microbatch; the scan-reduction-free check pins the structure)
+    "grad_cache_2d": {"all_gather": 22, "psum": 78, "reduce_scatter": 22},
     "video_embed": {},
     "text_embed": {},
     "softdtw_scan_grad": {},
@@ -114,6 +149,27 @@ def collective_counts(jaxpr) -> dict:
     for eqn in iter_eqns(jaxpr):
         if eqn.primitive.name in COLLECTIVES:
             out[eqn.primitive.name] = out.get(eqn.primitive.name, 0) + 1
+    return out
+
+
+def scan_collective_counts(jaxpr) -> dict:
+    """Collective counts INSIDE ``lax.scan`` bodies, anywhere in the
+    nest — the once-per-optimizer-step grad-reduction pin (ISSUE 6): a
+    cross-mesh reduction that slips under the microbatch scan executes
+    M times per step and silently re-pays the collective for the same
+    bytes (the structure behind the ga=8 throughput hole BENCH_NOTES.md
+    records).  Sibling scans accumulate; nested scans would double-count
+    through their parent (none exist in the pinned programs)."""
+    import jax
+
+    out: dict[str, int] = {}
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name != "scan":
+            continue
+        body = eqn.params.get("jaxpr")
+        inner = body.jaxpr if isinstance(body, jax.core.ClosedJaxpr) else body
+        for name, n in collective_counts(inner).items():
+            out[name] = out.get(name, 0) + n
     return out
 
 
@@ -186,14 +242,15 @@ def _setup():
     return model, opt, mesh, state, batch
 
 
-def _jaxpr_checks(name: str, fn, args) -> list[CheckResult]:
+def _jaxpr_checks(name: str, fn, args, scan_reduction_free: bool = False
+                  ) -> list[CheckResult]:
     import jax
 
     jaxpr = jax.make_jaxpr(fn)(*args).jaxpr
     bad = f64_sites(jaxpr)
     got = collective_counts(jaxpr)
     want = EXPECTED_COLLECTIVES[name]
-    return [
+    out = [
         CheckResult(name, "no-f64", not bad,
                     "; ".join(bad[:4]) if bad else ""),
         CheckResult(name, "collectives", got == want,
@@ -201,6 +258,15 @@ def _jaxpr_checks(name: str, fn, args) -> list[CheckResult]:
                     "(communication structure changed — if intended, re-pin "
                     "EXPECTED_COLLECTIVES)"),
     ]
+    if scan_reduction_free:
+        inside = scan_collective_counts(jaxpr)
+        out.append(CheckResult(
+            name, "scan-reduction-free", not inside,
+            "" if not inside else
+            f"collectives inside scan bodies: {inside} — the cross-mesh "
+            "grad reduction must run ONCE per optimizer step, after the "
+            "microbatch scan, never per microbatch"))
+    return out
 
 
 def _recompile_check(name: str, fn, make_args, call=None) -> CheckResult:
@@ -317,6 +383,117 @@ def _entry_train_step_milnce_instrumented() -> list[CheckResult]:
     return out
 
 
+# FSDP threshold for the 2-D entries: low enough that the tiny entry
+# model actually SHARDS several kernels on the 4x2 grid (the production
+# default, 65536 elements, would shard nothing at this scale and the
+# entries would pin a vacuously-replicated program).
+_FSDP_MIN_SIZE = 256
+
+
+@functools.lru_cache(maxsize=1)
+def _setup_2d():
+    """The 4x2 ``(data, model)`` twin of :func:`_setup`: same tiny model
+    and state, mesh reshaped, state sharded per the FSDP map and placed."""
+    from milnce_tpu.config import ParallelConfig
+    from milnce_tpu.parallel.mesh import build_mesh
+    from milnce_tpu.parallel.sharding_map import shard_and_place_state
+
+    model, opt, _mesh1, state, batch = _setup()
+    mesh = build_mesh(ParallelConfig(model_axis="model",
+                                     model_parallel_size=2))
+    placement = shard_and_place_state(state, mesh, "model",
+                                      min_size=_FSDP_MIN_SIZE)
+    assert placement.n_sharded > 0, (
+        "2-D entry setup shards nothing — the pinned program would be "
+        f"pure replication (threshold {_FSDP_MIN_SIZE})")
+    return model, opt, mesh, placement.specs, placement.state, batch
+
+
+def _entry_train_step_2d() -> list[CheckResult]:
+    """ISSUE 6 tentpole pins: the 2-D FSDP step's all_gather /
+    reduce_scatter pairs and per-leaf psums, the double-call recompile
+    check, and the guarded variant costing exactly ONE extra psum (the
+    model-axis finite-verdict reduction)."""
+    from milnce_tpu.train.step import make_train_step
+
+    model, opt, mesh, specs, state, batch = _setup_2d()
+    step = make_train_step(model, opt, mesh, donate=False,
+                           state_specs=specs, model_axis="model")
+    name = "train_step_milnce_2d"
+    out = _jaxpr_checks(name, step, (state,) + batch())
+    out.append(_recompile_check(name, step, lambda s: (state,) + batch(s)))
+    gstep = make_train_step(model, opt, mesh, donate=False,
+                            finite_guard=True, state_specs=specs,
+                            model_axis="model")
+    out += _jaxpr_checks("train_step_milnce_2d_guarded", gstep,
+                         (state,) + batch())
+    return out
+
+
+def _entry_grad_cache_2d() -> list[CheckResult]:
+    """2-D grad-cache: pinned collectives PLUS the once-per-step
+    structural pin — zero collectives inside the microbatch scans (the
+    param gather runs before pass 1, the reduction after pass 2)."""
+    from milnce_tpu.config import LossConfig
+    from milnce_tpu.train.step import make_grad_cache_step
+
+    model, opt, mesh, specs, state, batch = _setup_2d()
+    step = make_grad_cache_step(model, opt, mesh, 2, donate=False,
+                                loss_cfg=LossConfig(name="milnce"),
+                                state_specs=specs, model_axis="model")
+    return _jaxpr_checks("grad_cache_2d", step, (state,) + batch(),
+                         scan_reduction_free=True)
+
+
+def _entry_sdtw_pallas_dispatch() -> list[CheckResult]:
+    """ROADMAP item 1 loose end: ``SoftDTW(backend='auto')`` must keep a
+    STABLE compiled path across its shape-dispatch rule — one jit-cache
+    entry per dispatch shape, the second same-shape call a cache hit
+    (no recompiles), with the probed shapes covering BOTH sides of
+    ``prefers_pallas`` so the gate exercises kernel and scan paths alike
+    (the same gate discipline as the conv impls; BENCH_SOFTDTW.md)."""
+    import jax
+    import numpy as np
+
+    from milnce_tpu.ops.softdtw import SoftDTW
+    from milnce_tpu.ops.softdtw_pallas import prefers_pallas
+
+    name = "sdtw_pallas_dispatch"
+    sd = SoftDTW(gamma=0.1, dist_func="negative_dot", backend="auto")
+    fn = jax.jit(jax.value_and_grad(lambda x, y: sd(x, y).sum()))
+    # (B, N, M): one shape where the auto rule picks the Pallas kernel
+    # (batch-on-lanes regime), one where it picks the scan (tables past
+    # the VMEM budget) — re-derive with prefers_pallas if the rule moves
+    shapes = [(64, 4, 4), (2, 160, 160)]
+    sides = {prefers_pallas(b, n, m) for b, n, m in shapes}
+    out = [CheckResult(
+        name, "dispatch-coverage", sides == {True, False},
+        "" if sides == {True, False} else
+        f"probe shapes no longer straddle the auto rule ({sides}) — "
+        "re-pick shapes so both backends stay gated")]
+
+    def args(b, n, m, seed):
+        r = np.random.default_rng(seed)
+        return (r.standard_normal((b, n, 8)).astype(np.float32),
+                r.standard_normal((b, m, 8)).astype(np.float32))
+
+    if not hasattr(fn, "_cache_size"):
+        out.append(CheckResult(name, "recompile", True,
+                               "skipped: no _cache_size on this jax"))
+        return out
+    for b, n, m in shapes:
+        fn(*args(b, n, m, 0))
+        fn(*args(b, n, m, 1))
+    n_entries = fn._cache_size()
+    out.append(CheckResult(
+        name, "recompile", n_entries == len(shapes),
+        "" if n_entries == len(shapes) else
+        f"{n_entries} jit-cache entries for {len(shapes)} dispatch "
+        "shapes called twice each — the auto backend retraces per call "
+        "(unstable dispatch input)"))
+    return out
+
+
 def _entry_train_step_sdtw3() -> list[CheckResult]:
     from milnce_tpu.config import LossConfig
     from milnce_tpu.train.step import make_train_step
@@ -335,7 +512,8 @@ def _entry_grad_cache_step() -> list[CheckResult]:
     model, opt, mesh, state, batch = _setup()
     step = make_grad_cache_step(model, opt, mesh, 2, donate=False,
                                 loss_cfg=LossConfig(name="milnce"))
-    return _jaxpr_checks("grad_cache_step_milnce", step, (state,) + batch())
+    return _jaxpr_checks("grad_cache_step_milnce", step, (state,) + batch(),
+                         scan_reduction_free=True)
 
 
 def _entry_retrieval_embed() -> list[CheckResult]:
@@ -488,6 +666,9 @@ ENTRY_POINTS = {
     "train_step_milnce_instrumented": _entry_train_step_milnce_instrumented,
     "train_step_sdtw3": _entry_train_step_sdtw3,
     "grad_cache_step_milnce": _entry_grad_cache_step,
+    "train_step_milnce_2d": _entry_train_step_2d,
+    "grad_cache_2d": _entry_grad_cache_2d,
+    "sdtw_pallas_dispatch": _entry_sdtw_pallas_dispatch,
     "retrieval_embed": _entry_retrieval_embed,
     "softdtw_scan": _entry_softdtw_scan,
     "param_treedef": _entry_param_treedef,

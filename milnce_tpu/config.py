@@ -183,8 +183,31 @@ class ParallelConfig:
     with `jax.distributed.initialize` + one GSPMD program over a named mesh."""
 
     data_axis: str = "data"             # batch-sharded axis (DP + global negatives)
-    model_axis: Optional[str] = None    # optional TP axis (S3D is small; off by default)
+    model_axis: Optional[str] = None    # FSDP/model axis: set (with
+                                        # model_parallel_size > 1) to train
+                                        # on a 2-D (data, model) mesh with
+                                        # large params sharded per the
+                                        # sharding map (parallel/
+                                        # sharding_map.py, PERF.md)
     model_parallel_size: int = 1
+    fsdp_min_size: int = 65536          # FSDP threshold (ELEMENTS): params
+                                        # with >= this many elements shard
+                                        # over model_axis on their largest
+                                        # divisible dim; smaller ones
+                                        # replicate (gather latency beats
+                                        # the storage win below it)
+    sharding_map: str = ""              # per-param overrides on top of the
+                                        # size rule: inline 'glob=dim[,...]'
+                                        # ('-' = force-replicate) or a JSON
+                                        # artifact path, mirroring
+                                        # model.conv_impl_map.  '' = pure
+                                        # automatic rule.
+    overlap_grad_reduce: bool = True    # 2-D mesh only: reduce grads
+                                        # per-leaf (XLA can overlap each
+                                        # reduction with the rest of the
+                                        # backward) instead of one fused
+                                        # terminal psum; the 1-D step keeps
+                                        # its pinned fused reduction
     coordinator_address: Optional[str] = None   # multi-host bootstrap (None = single host)
     num_processes: Optional[int] = None
     process_id: Optional[int] = None

@@ -1,0 +1,372 @@
+"""FSDP-style per-parameter sharding map for the ``(data, model)`` mesh.
+
+The training runtime is natively 2-D (ROADMAP item 2, SNIPPETS.md
+[1]-[3]): the batch shards over the ``data`` axis as always, and LARGE
+parameter tensors additionally shard over the ``model`` axis so every
+chip stores only ``1/model_parallel_size`` of each big kernel and of its
+Adam moments.  This module owns the *map* — which tensor shards, on
+which dimension — and the placement helpers; the train step
+(train/step.py) owns the collectives that the map implies (per-leaf
+all_gather of sharded params before the forward, slice +
+reduce-scatter-style grad reduction after the backward).
+
+Map construction mirrors ``ModelConfig.conv_impl_map``: an automatic
+size-threshold rule covers everything, and an optional inline spec or
+JSON artifact (``ParallelConfig.sharding_map``) overrides per-parameter
+decisions by path glob.  The chosen map is summarized and hashed so
+bench records (``milnce.obs/v1``) can tell two runs' layouts apart.
+
+Default rule (the FSDP size threshold):
+
+- a parameter with ``>= min_size`` elements shards on its
+  largest-extent dimension divisible by the model-axis size (ties break
+  toward the LAST dim — channels-out for conv kernels, which keeps the
+  gathered layout contiguous);
+- everything smaller — BN scales, biases, the text tower's small
+  denses — replicates: gathering a 64-float vector costs more latency
+  than its storage ever saves;
+- a large parameter with NO divisible dimension replicates too, and is
+  *counted*: callers (bench.py) warn when the map shards nothing, so a
+  silently-replicated-everything 2-D run cannot masquerade as FSDP.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import hashlib
+import json
+import math
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Elements, not bytes: 65536 f32 elements = 256 KiB per replica — below
+# this, the per-step all_gather latency outweighs the storage win.
+DEFAULT_FSDP_MIN_SIZE = 65536
+
+
+def is_spec(x) -> bool:
+    """PartitionSpec subclasses tuple on older jax, so plain tree_map
+    would recurse INTO a spec; every tree walk over spec trees must pass
+    this as ``is_leaf``."""
+    return isinstance(x, P)
+
+
+def spec_leaves(spec_tree) -> list:
+    return jax.tree_util.tree_leaves(spec_tree, is_leaf=is_spec)
+
+
+def map_with_specs(f, tree, spec_tree):
+    """``tree_map(f, tree, spec_tree)`` that treats PartitionSpec leaves
+    as atoms (see :func:`is_spec`)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    specs = spec_leaves(spec_tree)
+    assert len(leaves) == len(specs), (len(leaves), len(specs))
+    return treedef.unflatten([f(l, s) for l, s in zip(leaves, specs)])
+
+
+def sharded_dim(spec: P, axis_name: str) -> Optional[int]:
+    """Index of the dim ``spec`` shards over ``axis_name``; None if
+    replicated on that axis."""
+    for d, names in enumerate(spec):
+        if names is None:
+            continue
+        names = names if isinstance(names, tuple) else (names,)
+        if axis_name in names:
+            return d
+    return None
+
+
+def _dim_spec(dim: int, axis_name: str) -> P:
+    """``P`` sharding ``dim`` over ``axis_name``, NORMALIZED: no trailing
+    ``None`` entries.  jax normalizes away trailing Nones on the arrays a
+    ``shard_map`` returns, so an un-normalized spec here would make the
+    step's INPUT layout compare unequal to its own OUTPUT layout and
+    retrace the program on the second step (one jit-cache entry per
+    optimizer step — the recompile class the 0-recompile acceptance gate
+    exists to catch)."""
+    return P(*([None] * dim + [axis_name]))
+
+
+def _auto_dim(shape: tuple, axis_size: int, min_size: int) -> Optional[int]:
+    """The dimension the automatic rule shards, or None (replicate)."""
+    if math.prod(shape) < max(1, min_size):
+        return None
+    best = None
+    for d, extent in enumerate(shape):
+        if extent % axis_size == 0 and extent >= axis_size:
+            if best is None or extent >= shape[best]:
+                best = d
+    return best
+
+
+def parse_sharding_spec(spec: str) -> dict:
+    """``ParallelConfig.sharding_map`` -> ``{path_glob: dim | None}``.
+
+    Accepts '' (empty — pure automatic rule), an inline
+    ``glob=dim[,glob=dim...]`` spec (``dim`` an integer, or ``-`` to
+    force-replicate), or a path to a JSON file — either a raw map or an
+    artifact whose map lives under the ``sharding_map`` key.  Mirrors
+    ``config.parse_conv_impl_map``: malformed items fail at config time,
+    not as silently-ignored keys."""
+    if not spec:
+        return {}
+    if "=" in spec:
+        items = [item for item in spec.split(",") if item]
+        bad = [item for item in items if "=" not in item]
+        if bad:
+            raise ValueError(f"sharding map items missing '=': {bad} "
+                             "(inline form is 'glob=dim[,glob=dim...]')")
+        mapping = dict(item.split("=", 1) for item in items)
+    else:
+        with open(spec) as fh:
+            payload = json.load(fh)
+        mapping = payload.get("sharding_map", payload)
+    out: dict = {}
+    for pattern, val in mapping.items():
+        if val in ("-", None):
+            out[pattern] = None
+            continue
+        try:
+            out[pattern] = int(val)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"sharding map entry {pattern!r} has dim {val!r} — "
+                "expected an integer dim index or '-' (replicate)")
+    return out
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        for attr in ("key", "name", "idx"):
+            if hasattr(p, attr):
+                parts.append(str(getattr(p, attr)))
+                break
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def build_param_specs(params, mesh: Mesh, model_axis: str,
+                      min_size: int = DEFAULT_FSDP_MIN_SIZE,
+                      spec: str = ""):
+    """Per-parameter PartitionSpec tree for ``params``.
+
+    Raises when ``model_axis`` is absent from ``mesh`` (a map naming a
+    phantom axis would trace fine and silently replicate everything —
+    the exact failure GL009 exists to catch in source) and when an
+    override pattern matches no parameter or names an unshardable dim."""
+    if model_axis not in mesh.axis_names:
+        raise ValueError(
+            f"sharding map targets axis {model_axis!r} but the mesh has "
+            f"axes {mesh.axis_names} — build the mesh with "
+            "ParallelConfig.model_axis/model_parallel_size first")
+    axis_size = mesh.shape[model_axis]
+    overrides = parse_sharding_spec(spec)
+    matched: set = set()
+
+    def spec_for(path, leaf):
+        name = _path_str(path)
+        shape = tuple(leaf.shape)
+        dim = _auto_dim(shape, axis_size, min_size)
+        for pattern, odim in overrides.items():
+            if fnmatch.fnmatchcase(name, pattern):
+                matched.add(pattern)
+                dim = odim
+                if dim is not None:
+                    if not (0 <= dim < len(shape)):
+                        raise ValueError(
+                            f"sharding map override {pattern!r}: dim {dim} "
+                            f"out of range for {name} {shape}")
+                    if shape[dim] % axis_size != 0:
+                        raise ValueError(
+                            f"sharding map override {pattern!r}: {name} dim "
+                            f"{dim} (extent {shape[dim]}) does not divide "
+                            f"the {model_axis} axis size {axis_size}")
+        if dim is None:
+            return P()
+        return _dim_spec(dim, model_axis)
+
+    specs = jax.tree_util.tree_map_with_path(spec_for, params)
+    unmatched = set(overrides) - matched
+    if unmatched:
+        raise ValueError(
+            f"sharding map patterns matched no parameter: "
+            f"{sorted(unmatched)} (typo'd glob — params are addressed by "
+            "their '/'-joined tree path)")
+    return specs
+
+
+def describe_map(params, specs, model_axis: str) -> dict:
+    """``{path: 'model@dim (shape)' | 'replicated (shape)'}`` — the
+    human/machine summary the hash and bench warnings are built from."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    out = {}
+    for (path, leaf), spec in zip(flat, spec_leaves(specs)):
+        dim = sharded_dim(spec, model_axis)
+        shape = "x".join(str(s) for s in leaf.shape)
+        out[_path_str(path)] = (f"{model_axis}@{dim} ({shape})"
+                                if dim is not None
+                                else f"replicated ({shape})")
+    return out
+
+
+def map_hash(summary: dict) -> str:
+    """Stable 12-hex digest of a :func:`describe_map` summary — emitted
+    into ``milnce.obs/v1`` bench records so 1-D and 2-D runs (and two
+    different maps) are distinguishable in ``obs_report``."""
+    blob = json.dumps(summary, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:12]
+
+
+def sharded_count(specs, model_axis: str) -> int:
+    return sum(1 for s in spec_leaves(specs)
+               if sharded_dim(s, model_axis) is not None)
+
+
+def state_partition_specs(state, mesh: Mesh, model_axis: str,
+                          min_size: int = DEFAULT_FSDP_MIN_SIZE,
+                          spec: str = ""):
+    """TrainState-of-PartitionSpec for the whole train state.
+
+    - ``params``: :func:`build_param_specs` (automatic rule + overrides);
+    - ``opt_state``: each leaf inherits the spec of the param whose tree
+      path it mirrors (Adam's mu/nu repeat the param tree under a
+      prefix; longest path-suffix match, shapes verified), falling back
+      to the automatic rule — so an override on a kernel moves its
+      moments with it even when a same-shape sibling exists, and scalars
+      (step counts, injected hyperparams) replicate;
+    - ``batch_stats``: ALWAYS replicated — BatchNorm applies and
+      pmean-merges full per-channel vectors every step, so a sharded
+      stats leaf would buy a few KB and cost a gather in the forward
+      (and under an aggressively low test threshold it would silently
+      change the program);
+    - ``step``: replicated scalar.
+    """
+    axis_size = mesh.shape[model_axis]
+    param_specs = build_param_specs(state.params, mesh, model_axis,
+                                    min_size=min_size, spec=spec)
+    # Moments follow their parameter by TREE-PATH SUFFIX, not by shape:
+    # Adam's mu/nu mirror the param tree under a prefix (.mu/conv/kernel
+    # <- conv/kernel), and a shape-keyed lookup would hand every
+    # same-shape sibling the FIRST sibling's spec — an override on one
+    # of two identical kernels would silently mis-spec the other's
+    # moments and fail at trace time with a local-vs-global shape error.
+    flat_params, _ = jax.tree_util.tree_flatten_with_path(state.params)
+    by_path = {_path_str(path): (tuple(leaf.shape), sp)
+               for (path, leaf), sp in zip(flat_params,
+                                           spec_leaves(param_specs))}
+
+    def follow(path, leaf):
+        name = _path_str(path)
+        shape = tuple(leaf.shape)
+        best = None
+        for ppath, (pshape, sp) in by_path.items():
+            if shape == pshape and (name == ppath
+                                    or name.endswith("/" + ppath)):
+                if best is None or len(ppath) > len(best[0]):
+                    best = (ppath, sp)
+        if best is not None:
+            return best[1]
+        # scalars (step counts), injected hyperparams, anything not
+        # mirroring a param: the automatic rule
+        dim = _auto_dim(shape, axis_size, min_size)
+        if dim is None:
+            return P()
+        return _dim_spec(dim, model_axis)
+
+    return state.replace(
+        step=P(),
+        params=param_specs,
+        batch_stats=jax.tree_util.tree_map(lambda _: P(),
+                                           state.batch_stats),
+        opt_state=jax.tree_util.tree_map_with_path(follow, state.opt_state))
+
+
+def tree_shardings(spec_tree, mesh: Mesh):
+    """Spec tree -> NamedSharding tree (placement form of the map)."""
+    return jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s),
+                                  spec_tree, is_leaf=is_spec)
+
+
+def _already_placed(x, sh) -> bool:
+    if not isinstance(x, jax.Array) or not hasattr(x, "sharding"):
+        return False
+    try:
+        return x.sharding.is_equivalent_to(sh, x.ndim)
+    except (AttributeError, TypeError):
+        return x.sharding == sh
+
+
+def place_tree(tree, spec_tree, mesh: Mesh):
+    """Place ``tree`` on ``mesh`` per the spec tree — THE reshard path.
+
+    Handles every arrival sharding the runtime produces: a fresh init or
+    an Orbax restore committed to one device, a 1-D-mesh checkpoint
+    restoring onto a 2-D mesh, and the reverse (a 2-D FSDP checkpoint
+    opening on a plain data mesh).  A leaf ALREADY in the target
+    sharding passes through untouched — the rollback path restores into
+    the live state's shardings, so its re-place is an identity and must
+    not round-trip bytes (multi-process it CANNOT: a model-axis shard's
+    siblings live on other hosts).  Single-process uses the plain
+    ``device_put`` fast path; multi-process assembles each global array
+    from process-local host data via ``make_array_from_callback``
+    (mirroring ``mesh.replicate_to_mesh``'s reasoning) — which requires
+    the arrival value to be fully addressable (host numpy from a
+    restore, or a replicated array); a cross-LAYOUT reshard of an
+    already-partitioned global array would need a cross-host gather, so
+    it fails loudly with the supported route instead of crashing inside
+    ``np.asarray``."""
+    import numpy as np
+
+    shardings = tree_shardings(spec_tree, mesh)
+    if jax.process_count() == 1:
+        return jax.tree_util.tree_map(
+            lambda x, sh: x if _already_placed(x, sh)
+            else jax.device_put(x, sh),
+            tree, shardings)
+
+    def place(x, sh):
+        if _already_placed(x, sh):
+            return x
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            raise ValueError(
+                f"cannot reshard a non-fully-addressable array from "
+                f"{x.sharding} to {sh} in process — restore it from a "
+                "checkpoint onto the target mesh instead (restores read "
+                "host data and place straight into the target layout)")
+        host = np.asarray(x)
+        return jax.make_array_from_callback(
+            host.shape, sh, lambda idx: host[idx])
+
+    return jax.tree_util.tree_map(place, tree, shardings)
+
+
+class ShardedPlacement:
+    """``shard_and_place_state`` result: the placed state plus the map
+    identity every caller reports (summary/hash/sharded count)."""
+
+    def __init__(self, state, specs, summary, digest, n_sharded):
+        self.state = state
+        self.specs = specs
+        self.summary = summary
+        self.hash = digest
+        self.n_sharded = n_sharded
+
+
+def shard_and_place_state(state, mesh: Mesh, model_axis: str,
+                          min_size: int = DEFAULT_FSDP_MIN_SIZE,
+                          spec: str = "") -> ShardedPlacement:
+    """Build the state spec tree, summarize it, and place the state —
+    the one sequence every 2-D entry point (train loop, bench,
+    trace-invariant setup) runs.  Callers differ only in how they react
+    to ``n_sharded == 0`` (warn / refuse / assert), so that stays with
+    them."""
+    specs = state_partition_specs(state, mesh, model_axis,
+                                  min_size=min_size, spec=spec)
+    summary = describe_map(state.params, specs.params, model_axis)
+    return ShardedPlacement(place_tree(state, specs, mesh), specs, summary,
+                            map_hash(summary),
+                            sharded_count(specs.params, model_axis))

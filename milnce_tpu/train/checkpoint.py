@@ -5,6 +5,16 @@ one checkpoint per epoch, sliding retention window (default 10), resume
 from the newest — but sharded/async via Orbax instead of rank-0
 ``torch.save`` of a monolithic state dict, so multi-host saves scale and
 don't stall the step loop.
+
+Mesh-layout portability (MIGRATING.md "Checkpoint resharding"): a
+checkpoint carries GLOBAL arrays, never a mesh layout, so restores are
+layout-agnostic in both directions — a 1-D data-mesh run's checkpoint
+opens on a 2-D ``(data, model)`` FSDP grid and vice versa.  ``restore``
+reads straight into whatever sharding the template's arrays carry
+(the rollback path passes the LIVE 2-D-sharded state); ``restore_latest``
+callers that restore onto an unplaced template re-place afterwards
+through the run's single placement path (train/loop.py ``place_state``
+-> ``sharding_map.place_tree``), which performs the actual reshard.
 """
 
 from __future__ import annotations

@@ -162,6 +162,21 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
     initialize_distributed(cfg.parallel)
     mesh = build_mesh(cfg.parallel)
     axis = cfg.parallel.data_axis
+    # 2-D (data, model) mesh: the batch shards over BOTH axes (every
+    # chip is a data shard — global-batch semantics identical to a 1-D
+    # mesh of the same size) and the train state shards per the FSDP
+    # sharding map (parallel/sharding_map.py, PERF.md).
+    model_axis = cfg.parallel.model_axis
+    if model_axis and model_axis not in mesh.axis_names:
+        # refuse-loudly, like every other silent-replication path in the
+        # 2-D stack (GL009, build_param_specs, bench's shards-NOTHING):
+        # a model_axis that never made it into the mesh would quietly
+        # train 1-D while the config claims FSDP
+        raise ValueError(
+            f"parallel.model_axis={model_axis!r} is set but the mesh has "
+            f"axes {mesh.axis_names} — set parallel.model_parallel_size "
+            f"> 1 (it is {cfg.parallel.model_parallel_size})")
+    batch_axes = (axis, model_axis) if model_axis else axis
 
     logger = RunLogger(cfg.train.log_root, cfg.train.checkpoint_dir,
                        enabled=jax.process_index() == 0 and cfg.train.verbose)
@@ -206,7 +221,7 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
     steps_per_epoch = loader.steps_per_epoch()
     assert steps_per_epoch > 0, "dataset smaller than one global batch"
 
-    model = build_model(cfg.model, bn_axis_name=axis)
+    model = build_model(cfg.model, bn_axis_name=batch_axes)
     rng = jax.random.PRNGKey(cfg.train.seed)
     sample_video = np.zeros((2, cfg.data.num_frames, cfg.data.video_size,
                              cfg.data.video_size, 3), np.float32)
@@ -223,6 +238,38 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
     schedule = build_schedule(cfg.optim, steps_per_epoch)
     optimizer = build_optimizer(cfg.optim, schedule)
     state = create_train_state(variables, optimizer)
+
+    # State placement: the ONE path every arrival sharding goes through
+    # (fresh init, Orbax restore, rollback restore) — on the 2-D mesh it
+    # also RESHARDS, so a 1-D-mesh checkpoint opens on a (data, model)
+    # grid and vice versa (MIGRATING.md).
+    if model_axis:
+        from milnce_tpu.parallel.sharding_map import (place_tree,
+                                                      shard_and_place_state)
+
+        placement = shard_and_place_state(
+            state, mesh, model_axis, min_size=cfg.parallel.fsdp_min_size,
+            spec=cfg.parallel.sharding_map)
+        state_specs = placement.specs
+        logger.log(f"sharding map: {placement.n_sharded}/"
+                   f"{len(placement.summary)} params "
+                   f"sharded on '{model_axis}' "
+                   f"(threshold {cfg.parallel.fsdp_min_size} elements, "
+                   f"hash {placement.hash})")
+        if placement.n_sharded == 0:
+            logger.log("sharding map WARNING: no parameter shards — the "
+                       "2-D mesh is paying model-axis collectives for "
+                       "pure replication (lower parallel.fsdp_min_size "
+                       "or fix parallel.sharding_map)")
+        # the fresh state is already placed; a restore below then uses
+        # the PLACED state as its template, so Orbax reads any
+        # checkpoint (1-D or 2-D origin) straight into the FSDP layout
+        # and the explicit place_state after it is an identity
+        state = placement.state
+        place_state = lambda s: place_tree(s, state_specs, mesh)  # noqa: E731
+    else:
+        state_specs = None
+        place_state = lambda s: replicate_to_mesh(s, mesh)  # noqa: E731
 
     ckpt_dir = os.path.join(cfg.train.checkpoint_root,
                             cfg.train.checkpoint_dir or "run")
@@ -243,26 +290,40 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
         logger.log(f"resumed from epoch {start_epoch}"
                    + (f" at batch {resume_skip}" if resume_skip else ""))
 
-    # Explicitly replicate the state (freshly initialized OR restored —
-    # both land committed to one device) over the mesh NOW: leaving it
-    # single-device made the first step_fn call perform the re-replication
+    # Explicitly place the state (freshly initialized OR restored — both
+    # land committed to one device) over the mesh NOW: leaving it
+    # single-device made the first step_fn call perform the re-placement
     # as an IMPLICIT device-to-device transfer — invisible until the
     # steady-state transfer guard flagged it.  Multihost-safe: assembles
     # from process-local data instead of a cross-host device_put, so it
     # composes with the batch-sharded step inputs.
-    state = replicate_to_mesh(state, mesh)
+    state = place_state(state)
+    if model_axis:
+        # the FSDP storage win, made visible: per-chip bytes of the
+        # placed state (host-side shard inspection, no transfer)
+        from milnce_tpu.train.state import per_device_state_bytes
+
+        per_dev = per_device_state_bytes(state)
+        if per_dev:
+            logger.log(f"state bytes/chip: "
+                       f"{max(per_dev.values()) / 2 ** 20:.2f} MiB "
+                       f"(params + moments + stats, post-sharding)")
 
     guard_on = cfg.train.finite_guard
     if cfg.train.grad_accum > 1:
         from milnce_tpu.train.step import make_grad_cache_step
 
-        step_fn = make_grad_cache_step(model, optimizer, mesh,
-                                       cfg.train.grad_accum, data_axis=axis,
-                                       loss_cfg=cfg.loss,
-                                       finite_guard=guard_on)
+        step_fn = make_grad_cache_step(
+            model, optimizer, mesh, cfg.train.grad_accum, data_axis=axis,
+            loss_cfg=cfg.loss, finite_guard=guard_on,
+            state_specs=state_specs, model_axis=model_axis,
+            overlap_grad_reduce=cfg.parallel.overlap_grad_reduce)
     else:
-        step_fn = make_train_step(model, optimizer, mesh, data_axis=axis,
-                                  loss_cfg=cfg.loss, finite_guard=guard_on)
+        step_fn = make_train_step(
+            model, optimizer, mesh, data_axis=axis, loss_cfg=cfg.loss,
+            finite_guard=guard_on, state_specs=state_specs,
+            model_axis=model_axis,
+            overlap_grad_reduce=cfg.parallel.overlap_grad_reduce)
 
     # Preemption-safe shutdown: TPU-VM maintenance events deliver SIGTERM;
     # save a checkpoint and exit cleanly instead of losing the epoch (the
@@ -336,7 +397,7 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
     # np.zeros INSIDE the loop fed the jitted step an implicit H2D
     # transfer every step.  Placed once, explicitly, mesh-sharded via the
     # same placement helper the prefetcher uses.
-    zero_start = shard_placer(mesh, axis)(
+    zero_start = shard_placer(mesh, batch_axes)(
         np.zeros((cfg.train.batch_size // jax.process_count(),),
                  np.float32))
 
@@ -393,7 +454,7 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                     _in_training_eval(cfg, model, state, mesh, logger)
             skip = resume_skip if epoch == start_epoch else 0
             for batch in device_prefetch(loader.epoch(epoch, skip_batches=skip),
-                                         mesh, axis,
+                                         mesh, batch_axes,
                                          depth=cfg.data.prefetch_depth):
                 video, text = flatten_text(batch)
                 start = batch.get("start", zero_start)
@@ -524,7 +585,7 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                             restored = manager.restore(latest, state)
                         state = restored.replace(
                             step=jnp.asarray(opt_step, jnp.int32))
-                        state = replicate_to_mesh(state, mesh)
+                        state = place_state(state)
                         rollbacks += 1
                         m_rollbacks.inc()
                         rec.event("rollback", step=opt_step,

@@ -68,6 +68,21 @@ def create_train_state(variables, optimizer) -> TrainState:
     )
 
 
+def per_device_state_bytes(state) -> dict:
+    """``{device: bytes}`` of a PLACED train state — the FSDP storage
+    accounting.  Replicated leaves count full-size on every device;
+    model-axis-sharded leaves (parallel/sharding_map.py) count only
+    their shard, so on a 2-D mesh the per-chip total visibly drops by
+    the sharded fraction (asserted in tests/test_train_2d.py; logged at
+    startup by train/loop.py).  Pure host-side inspection of committed
+    arrays (``addressable_shards``) — no transfer, no device compute."""
+    out: dict = {}
+    for leaf in jax.tree_util.tree_leaves(state):
+        for sh in getattr(leaf, "addressable_shards", ()):
+            out[sh.device] = out.get(sh.device, 0) + sh.data.nbytes
+    return out
+
+
 # NOTE: the old ``current_lr(state)`` helper (read the injected
 # hyperparam back from DEVICE) is gone: it was a host sync by
 # construction and had no remaining callers — LR display everywhere

@@ -18,6 +18,25 @@ all-reduce backward + Adam/SGD + scheduler step all fuse into a single
   README.md:13 — merging is the same cost and strictly less arbitrary);
 - the LR schedule is a pure function of ``state.step``
   (utils.py:26-38), no separate scheduler object.
+
+2-D ``(data, model)`` mesh (ROADMAP item 2, SNIPPETS.md [1]-[3]): pass
+``state_specs`` (a TrainState of PartitionSpec from
+``parallel.sharding_map.state_partition_specs``) plus ``model_axis`` and
+the step goes FSDP: the batch shards over BOTH axes (every chip is a
+data shard — global-batch semantics are identical to the 1-D mesh, so
+local BN needs no sync), large params arrive as model-axis shards and
+are all_gathered per leaf right before the forward, and the grad
+reduction runs per leaf — ``psum_scatter`` over the model axis (the
+reduce-scatter half of the FSDP pair) + ``psum`` over data for sharded
+leaves, a plain both-axes ``psum`` for replicated ones.  Per-leaf
+reductions are independent collectives, so XLA's latency-hiding
+scheduler can overlap each with the remainder of the backward instead
+of draining into one terminal fused psum (``overlap_grad_reduce``).
+The optimizer update then runs on the LOCAL shards: Adam moments for a
+sharded kernel never materialize beyond ``1/model_parallel_size`` per
+chip.  Collective counts for both 2-D steps are pinned in
+analysis/trace_invariants.py (``train_step_milnce_2d``,
+``grad_cache_2d``).
 """
 
 from __future__ import annotations
@@ -72,6 +91,79 @@ def _select_tree(ok, new, old):
     return jax.tree_util.tree_map(lambda n, o: jnp.where(ok, n, o), new, old)
 
 
+def _gather_params(params, param_specs, model_axis):
+    """FSDP gather: local model-axis shards -> full parameters, one
+    ``all_gather`` per SHARDED leaf (replicated leaves pass through).
+    Sits right before the forward so XLA can overlap each gather with
+    compute on already-gathered layers."""
+    from milnce_tpu.parallel import sharding_map as smap
+
+    def gather(leaf, spec):
+        d = smap.sharded_dim(spec, model_axis)
+        if d is None:
+            return leaf
+        return lax.all_gather(leaf, model_axis, axis=d, tiled=True)
+
+    return smap.map_with_specs(gather, params, param_specs)
+
+
+def _reduce_grads_2d(grads, param_specs, data_axis, model_axis,
+                     mesh_size: int, mean: bool, overlap: bool):
+    """Cross-mesh gradient reduction for the 2-D step: full per-device
+    grads -> fully-reduced LOCAL-shard grads.
+
+    Sharded leaf (model@d): ``psum_scatter`` over the model axis along d
+    (each chip keeps only ITS shard of the summed grad — the
+    reduce-scatter half of the FSDP pair; its transpose-twin all_gather
+    sits in :func:`_gather_params`) then ``psum`` over data.  Replicated
+    leaf: one psum over both axes.  ``mean=True`` (the DTW family's
+    pmean semantics) divides by the total mesh size after summing.
+
+    ``overlap=True`` emits the replicated-leaf psums per leaf too, so
+    every reduction is an independent collective the scheduler can
+    overlap with the rest of the backward; ``overlap=False`` fuses the
+    replicated subset into one terminal tree psum (the 1-D step's
+    pinned shape) — sharded leaves are per-leaf either way, their
+    scatter dimension differs."""
+    from milnce_tpu.parallel import sharding_map as smap
+
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    specs = smap.spec_leaves(param_specs)
+    assert len(leaves) == len(specs), (len(leaves), len(specs))
+    out: list = [None] * len(leaves)
+    fused_idx: list = []
+    for i, (g, sp) in enumerate(zip(leaves, specs)):
+        d = smap.sharded_dim(sp, model_axis)
+        if d is not None:
+            g = lax.psum_scatter(g, model_axis, scatter_dimension=d,
+                                 tiled=True)
+            out[i] = lax.psum(g, data_axis)
+        elif overlap:
+            out[i] = lax.psum(g, (data_axis, model_axis))
+        else:
+            fused_idx.append(i)
+    if fused_idx:
+        fused = lax.psum(tuple(leaves[i] for i in fused_idx),
+                         (data_axis, model_axis))
+        for i, g in zip(fused_idx, fused):
+            out[i] = g
+    if mean:
+        out = [g / mesh_size for g in out]
+    return treedef.unflatten(out)
+
+
+def _uniform_finite_verdict(ok, model_axis):
+    """The finite guard's verdict must be CLUSTER-UNIFORM, and on the
+    2-D mesh each model column inspects only ITS shard of the reduced
+    grads — a NaN landing in one column's shard would skip the update
+    there and apply it elsewhere, silently desyncing the replicas.  One
+    scalar psum over the model axis makes every column see every
+    column's verdict.  (The data axis needs nothing: post-psum grads
+    are identical along it.)"""
+    bad = lax.psum((~ok).astype(jnp.float32), model_axis)
+    return bad == 0
+
+
 def _sequence_loss(loss_cfg, v_seq, t_seq, start, data_axis):
     """DTW-family losses on mesh-gathered sequence embeddings.
 
@@ -124,7 +216,8 @@ def _check_loss_name(loss_cfg) -> str:
 def make_grad_cache_step(model, optimizer, mesh: Mesh,
                          micro_batches: int, data_axis: str = "data",
                          donate: bool = True, loss_cfg=None,
-                         finite_guard: bool = False):
+                         finite_guard: bool = False, state_specs=None,
+                         model_axis=None, overlap_grad_reduce: bool = True):
     """Two-pass embedding-cache train step (GradCache-style) for every
     batch-contrastive loss: MIL-NCE and the DTW family.
 
@@ -156,9 +249,25 @@ def make_grad_cache_step(model, optimizer, mesh: Mesh,
     (per-shard partial sums), ``pmean`` for the DTW family (the gathered
     loss is replicated on every shard, so the all_gather transpose
     already accumulates a mesh-size factor into the embedding grads).
+
+    The cross-mesh reduction happens ONCE per optimizer step, AFTER the
+    pass-2 scan has accumulated all M microbatches' local parameter
+    grads — never per microbatch (a reduction inside the scan body
+    would pay the collective M times for the same bytes: the ~25%
+    ga=8 throughput hole BENCH_NOTES.md records).  The property is
+    pinned structurally: the ``scan-reduction-free`` trace invariant
+    asserts no collective primitive in any scan body of this program
+    (analysis/trace_invariants.py).  With ``state_specs``/``model_axis``
+    the same program runs FSDP on the 2-D mesh (module docstring):
+    params gather once BEFORE pass 1, both scans run on the gathered
+    tree, and the once-per-step reduction becomes the per-leaf
+    psum_scatter+psum of :func:`_reduce_grads_2d`.
     """
     assert micro_batches > 1, "use make_train_step for micro_batches=1"
     loss_name = _check_loss_name(loss_cfg)
+    mesh_size = _check_2d_args(mesh, data_axis, model_axis, state_specs)
+    fsdp = model_axis is not None
+    batch_axes = (data_axis, model_axis) if fsdp else data_axis
     compute_dtype = jnp.dtype(getattr(model, "dtype", jnp.float32))
 
     def local_step(state: TrainState, video_u8, text_ids, start):
@@ -169,6 +278,12 @@ def make_grad_cache_step(model, optimizer, mesh: Mesh,
         vids = video_u8.reshape((micro_batches, bm) + video_u8.shape[1:])
         txts = text_ids.reshape((micro_batches, bm * k_rows)
                                 + text_ids.shape[1:])
+        # FSDP: gather the full params ONCE, outside both scans — a
+        # gather inside a scan body would re-ship every sharded kernel
+        # per microbatch (and break the scan-reduction-free invariant)
+        full_params = (_gather_params(state.params, state_specs.params,
+                                      model_axis)
+                       if fsdp else state.params)
 
         def fwd(params, batch_stats, vu8, tids):
             video = vu8.astype(compute_dtype) / jnp.asarray(255, compute_dtype)
@@ -180,7 +295,7 @@ def make_grad_cache_step(model, optimizer, mesh: Mesh,
         # pass 1: embed every microbatch, cache embeddings only
         def embed_one(_, xs):
             vu8, tids = xs
-            (v, t), mutated = fwd(state.params, state.batch_stats, vu8, tids)
+            (v, t), mutated = fwd(full_params, state.batch_stats, vu8, tids)
             return None, (v, t, mutated["batch_stats"])
 
         _, (v_mb, t_mb, stats_mb) = lax.scan(embed_one, None, (vids, txts))
@@ -192,17 +307,18 @@ def make_grad_cache_step(model, optimizer, mesh: Mesh,
         # negatives/pairs exactly as the single-pass step)
         if loss_name == "milnce":
             def loss_of(v, t):
-                return milnce_loss(v, t, axis_name=data_axis)
+                return milnce_loss(v, t, axis_name=batch_axes)
         else:
             def loss_of(v, t):
                 t_seq = t.reshape(b, -1, t.shape[-1])      # (B, K, D)
-                return _sequence_loss(loss_cfg, v, t_seq, start, data_axis)
+                return _sequence_loss(loss_cfg, v, t_seq, start, batch_axes)
 
         loss, (g_v, g_t) = jax.value_and_grad(
             loss_of, argnums=(0, 1))(v_local, t_local)
 
         # pass 2: re-forward each microbatch, seed its VJP with the
-        # cached embedding grads, accumulate parameter grads
+        # cached embedding grads, accumulate LOCAL parameter grads —
+        # the cross-mesh reduction stays outside the scan (docstring)
         g_v_mb = g_v.reshape((micro_batches, bm) + g_v.shape[1:])
         g_t_mb = g_t.reshape((micro_batches, bm * k_rows) + g_t.shape[1:])
 
@@ -213,25 +329,33 @@ def make_grad_cache_step(model, optimizer, mesh: Mesh,
                 (v, t), _ = fwd(params, state.batch_stats, vu8, tids)
                 return v, t
 
-            _, vjp = jax.vjp(f, state.params)
+            _, vjp = jax.vjp(f, full_params)
             (g,) = vjp((gv, gt))
             return jax.tree_util.tree_map(jnp.add, acc, g), None
 
-        zero = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+        zero = jax.tree_util.tree_map(jnp.zeros_like, full_params)
         grads, _ = lax.scan(grad_one, zero, (vids, txts, g_v_mb, g_t_mb))
 
-        reduce = lax.psum if loss_name == "milnce" else lax.pmean
-        grads = reduce(grads, data_axis)
+        if fsdp:
+            grads = _reduce_grads_2d(grads, state_specs.params, data_axis,
+                                     model_axis, mesh_size,
+                                     mean=loss_name != "milnce",
+                                     overlap=overlap_grad_reduce)
+        else:
+            reduce = lax.psum if loss_name == "milnce" else lax.pmean
+            grads = reduce(grads, data_axis)
         grads = _apply_grad_poison(grads, state.step)
         # merge BN stats over microbatches then shards: a microbatch is a
         # virtual shard, so mean-of-means matches the M*N-chip run
         new_stats = jax.tree_util.tree_map(
-            lambda x: lax.pmean(jnp.mean(x, axis=0), data_axis), stats_mb)
+            lambda x: lax.pmean(jnp.mean(x, axis=0), batch_axes), stats_mb)
         updates, new_opt = optimizer.update(grads, state.opt_state,
                                             state.params)
         new_params = optax.apply_updates(state.params, updates)
         if finite_guard:    # same skip-update semantics as make_train_step
             ok = _all_finite(grads)
+            if fsdp:
+                ok = _uniform_finite_verdict(ok, model_axis)
             new_params = _select_tree(ok, new_params, state.params)
             new_opt = _select_tree(ok, new_opt, state.opt_state)
             new_stats = _select_tree(ok, new_stats, state.batch_stats)
@@ -241,19 +365,41 @@ def make_grad_cache_step(model, optimizer, mesh: Mesh,
         return TrainState(step=state.step + 1, params=new_params,
                           batch_stats=new_stats, opt_state=new_opt), loss
 
-    out_specs = (P(), P(), P()) if finite_guard else (P(), P())
+    state_spec = state_specs if fsdp else P()
+    batch_spec = P(batch_axes)
+    tail = (P(), P()) if finite_guard else (P(),)
     sharded = shard_map(
         local_step, mesh=mesh,
-        in_specs=(P(), P(data_axis), P(data_axis), P(data_axis)),
-        out_specs=out_specs,
+        in_specs=(state_spec, batch_spec, batch_spec, batch_spec),
+        out_specs=(state_spec,) + tail,
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=donation_argnums(0) if donate else ())
 
 
+def _check_2d_args(mesh: Mesh, data_axis: str, model_axis, state_specs):
+    """Build-time validation of the 2-D knobs: a phantom axis or a
+    missing spec tree must fail HERE, not as a silent replication (the
+    failure mode GL009 and sharding_map.build_param_specs also guard)."""
+    if (model_axis is None) != (state_specs is None):
+        raise ValueError(
+            "2-D step needs BOTH model_axis and state_specs (build the "
+            "spec tree with parallel.sharding_map.state_partition_specs)")
+    if model_axis is None:
+        return None
+    for ax in (data_axis, model_axis):
+        if ax not in mesh.axis_names:
+            raise ValueError(
+                f"step axis {ax!r} absent from mesh axes {mesh.axis_names}")
+    import math
+
+    return math.prod(mesh.shape.values())
+
+
 def make_train_step(model, optimizer, mesh: Mesh, data_axis: str = "data",
                     donate: bool = True, loss_cfg=None, inner_steps: int = 1,
-                    finite_guard: bool = False):
+                    finite_guard: bool = False, state_specs=None,
+                    model_axis=None, overlap_grad_reduce: bool = True):
     """Build the jitted train step.
 
     Returns ``step_fn(state, video_u8, text_ids, start) -> (state, loss)``:
@@ -279,8 +425,20 @@ def make_train_step(model, optimizer, mesh: Mesh, data_axis: str = "data",
     inside one XLA program (``lax.scan``) per dispatch.  Benchmark use
     only: it amortizes per-dispatch host latency (a remote-tunnel execute
     costs seconds) so the measurement reflects device throughput.
+
+    ``state_specs``/``model_axis``/``overlap_grad_reduce``: the 2-D
+    FSDP path (module docstring).  ``state_specs=None`` keeps the 1-D
+    program byte-identical to before — its pinned collective counts
+    never move.
     """
     loss_name = _check_loss_name(loss_cfg)
+    mesh_size = _check_2d_args(mesh, data_axis, model_axis, state_specs)
+    fsdp = model_axis is not None
+    # the loss axes: on the 2-D mesh every chip is a data shard (the
+    # batch shards over BOTH axes), so negatives gather and grads reduce
+    # over the combined axes — global-batch semantics match the 1-D mesh
+    # of the same device count exactly, local BN included
+    batch_axes = (data_axis, model_axis) if fsdp else data_axis
     # normalize straight into the model's compute dtype: a bf16 model casts
     # the video to bf16 at conv1 anyway (Conv3D promote_dtype), so an f32
     # intermediate would only add HBM traffic on the largest activation
@@ -288,6 +446,9 @@ def make_train_step(model, optimizer, mesh: Mesh, data_axis: str = "data",
 
     def local_step(state: TrainState, video_u8, text_ids, start):
         video = video_u8.astype(compute_dtype) / jnp.asarray(255, compute_dtype)
+        full_params = (_gather_params(state.params, state_specs.params,
+                                      model_axis)
+                       if fsdp else state.params)
 
         def loss_fn(params):
             variables = {"params": params, "batch_stats": state.batch_stats}
@@ -295,7 +456,7 @@ def make_train_step(model, optimizer, mesh: Mesh, data_axis: str = "data",
                 (v_embd, t_embd), mutated = model.apply(
                     variables, video, text_ids, train=True,
                     mutable=["batch_stats"])
-                loss = milnce_loss(v_embd, t_embd, axis_name=data_axis)
+                loss = milnce_loss(v_embd, t_embd, axis_name=batch_axes)
             else:
                 (v_seq, t_embd), mutated = model.apply(
                     variables, video, text_ids, mode="sequence", train=True,
@@ -303,21 +464,29 @@ def make_train_step(model, optimizer, mesh: Mesh, data_axis: str = "data",
                 b = video.shape[0]
                 t_seq = t_embd.reshape(b, -1, t_embd.shape[-1])  # (B, K, D)
                 loss = _sequence_loss(loss_cfg, v_seq, t_seq, start,
-                                      data_axis)
+                                      batch_axes)
             return loss, mutated["batch_stats"]
 
         (loss, new_stats), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params)
-        reduce = lax.psum if loss_name == "milnce" else lax.pmean
-        grads = reduce(grads, data_axis)
+            loss_fn, has_aux=True)(full_params)
+        if fsdp:
+            grads = _reduce_grads_2d(grads, state_specs.params, data_axis,
+                                     model_axis, mesh_size,
+                                     mean=loss_name != "milnce",
+                                     overlap=overlap_grad_reduce)
+        else:
+            reduce = lax.psum if loss_name == "milnce" else lax.pmean
+            grads = reduce(grads, data_axis)
         grads = _apply_grad_poison(grads, state.step)
         new_stats = jax.tree_util.tree_map(
-            lambda x: lax.pmean(x, data_axis), new_stats)
+            lambda x: lax.pmean(x, batch_axes), new_stats)
         updates, new_opt = optimizer.update(grads, state.opt_state,
                                             state.params)
         new_params = optax.apply_updates(state.params, updates)
         if finite_guard:
             ok = _all_finite(grads)
+            if fsdp:
+                ok = _uniform_finite_verdict(ok, model_axis)
             new_params = _select_tree(ok, new_params, state.params)
             new_opt = _select_tree(ok, new_opt, state.opt_state)
             new_stats = _select_tree(ok, new_stats, state.batch_stats)
@@ -343,11 +512,13 @@ def make_train_step(model, optimizer, mesh: Mesh, data_axis: str = "data",
     else:
         local_fn = local_step
 
-    out_specs = (P(), P(), P()) if finite_guard else (P(), P())
+    state_spec = state_specs if fsdp else P()
+    batch_spec = P(batch_axes)
+    tail = (P(), P()) if finite_guard else (P(),)
     sharded = shard_map(
         local_fn, mesh=mesh,
-        in_specs=(P(), P(data_axis), P(data_axis), P(data_axis)),
-        out_specs=out_specs,
+        in_specs=(state_spec, batch_spec, batch_spec, batch_spec),
+        out_specs=(state_spec,) + tail,
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=donation_argnums(0) if donate else ())

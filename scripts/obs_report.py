@@ -202,6 +202,16 @@ def check(current: dict, baseline: dict, tolerance: float) -> tuple[bool,
             f"({sorted(base) or 'none'}) — artifacts are not comparable")
     lines = [f"gate: {current['path']} vs baseline {baseline['path']} "
              f"(tolerance {tolerance:.0%})"]
+    # mesh layout / sharding-map identity (ISSUE 6): 1-D vs 2-D runs ARE
+    # comparable (that comparison is the point of the fields), but a
+    # drift across layouts must be ATTRIBUTABLE — say so in the report
+    # instead of letting a layout change read as a plain regression
+    cur_doc, base_doc = current.get("doc") or {}, baseline.get("doc") or {}
+    for key in ("mesh", "sharding_map_hash"):
+        b, c = base_doc.get(key), cur_doc.get(key)
+        if (b or c) and b != c:
+            lines.append(f"  [note] {key} differs: baseline {b or '-'} "
+                         f"-> current {c or '-'} (cross-layout compare)")
     ok = True
     compared = 0
     for name in shared:

@@ -132,6 +132,24 @@ def traced_obs_suppressed(x):
     return x
 
 
+# ---- GL009 phantom-mesh-axis -------------------------------------------
+
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+
+def constrain_typo(x):
+    # GL009: 'modle' is declared by no mesh — GSPMD silently replicates
+    return jax.lax.with_sharding_constraint(x, P("modle", None))
+
+
+def constrain_foreign(x):
+    return jax.lax.with_sharding_constraint(x, P("expert"))  # graftlint: disable=GL009(fixture: the audited suppressed occurrence)
+
+
+def constrain_ok(x):
+    return jax.lax.with_sharding_constraint(x, P("data", "model"))
+
+
 # ---- GL000 bad-suppression ---------------------------------------------
 
 x_no_reason = 1  # graftlint: disable=GL001

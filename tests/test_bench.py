@@ -160,6 +160,17 @@ def test_peak_flops_lookup():
     assert bench._peak_flops("cpu") is None
 
 
+def test_parse_mesh_spec_grammar():
+    # '' = 1-D data mesh; 'data,model[=N]' = 2-D grid (default width 2);
+    # anything else fails at parse time like the config grammars
+    assert bench._parse_mesh_spec("") == (None, 1)
+    assert bench._parse_mesh_spec("data,model") == ("model", 2)
+    assert bench._parse_mesh_spec("data,model=4") == ("model", 4)
+    for bad in ("model,data", "data", "data,model,extra"):
+        with pytest.raises(ValueError, match="mesh spec"):
+            bench._parse_mesh_spec(bad)
+
+
 @pytest.mark.slow
 class TestConfigChild:
     """The per-config measurement grand-child protocol: one tagged JSON
@@ -213,6 +224,33 @@ class TestConfigChild:
         assert r["flops_per_step"] is None and "mfu" not in r
         assert r["clips_per_sec_per_chip"] > 0
         json.dumps(r)
+
+    def test_mesh_2d_row_carries_layout_identity(self, monkeypatch):
+        # the ISSUE 6 sweep axis: a 2-D row must record which layout and
+        # which sharding map produced the number (mesh shape + map hash),
+        # so obs_report compares like with like
+        monkeypatch.setenv("MILNCE_BENCH_FSDP_MIN", "256")
+        r = bench._run_config(timeout_s=600, platform_pin="cpu",
+                              dtype="float32", batch=16, frames=4, size=32,
+                              words=4, k=2, remat=False, inner=1, s2d=False,
+                              conv_impl="native", mesh_spec="data,model",
+                              peak=None, flops_hint=None)
+        assert r["mesh"] == "4x2 (data,model)"
+        assert r["params_sharded"] > 0
+        assert len(r["sharding_map_hash"]) == 12
+        assert r["clips_per_sec_per_chip"] > 0
+        json.dumps(r)
+
+    def test_mesh_2d_row_refuses_pure_replication(self, monkeypatch):
+        # a map that shards nothing must be REFUSED, not measured: paying
+        # model-axis collectives for replication is not an FSDP data point
+        monkeypatch.setenv("MILNCE_BENCH_FSDP_MIN", str(10 ** 9))
+        with pytest.raises(RuntimeError, match="shards NOTHING"):
+            bench._run_config(timeout_s=600, platform_pin="cpu",
+                              dtype="float32", batch=16, frames=4, size=32,
+                              words=4, k=2, remat=False, inner=1, s2d=False,
+                              conv_impl="native", mesh_spec="data,model",
+                              peak=None, flops_hint=None)
 
     def test_run_config_timeout_is_tagged(self):
         # a child that cannot finish inside the watchdog raises the

@@ -35,6 +35,7 @@ def test_fixture_violates_every_rule_exactly_once():
         "GL000": 2,       # missing reason + unknown rule
         "GL001": 1, "GL002": 1, "GL003": 1,
         "GL004": 1, "GL005": 1, "GL006": 1, "GL007": 1, "GL008": 1,
+        "GL009": 1,
     }, f"per-rule finding counts drifted: {dict(active)}"
 
 
@@ -43,7 +44,7 @@ def test_fixture_suppresses_every_rule_exactly_once():
     counts = Counter(f.rule.id for f in suppressed)
     assert counts == {"GL001": 1, "GL002": 1, "GL003": 1,
                       "GL004": 1, "GL005": 1, "GL006": 1, "GL007": 1,
-                      "GL008": 1}, (
+                      "GL008": 1, "GL009": 1}, (
         f"suppressed counts drifted: {dict(counts)}")
     assert all(f.suppress_reason for f in suppressed), (
         "suppressed findings must carry their audit reason")
@@ -84,7 +85,7 @@ def test_docstrings_mentioning_the_syntax_do_not_parse_as_suppressions():
 
 def test_rule_registry_is_consistent():
     assert set(RULES) == {"GL000", "GL001", "GL002", "GL003", "GL004",
-                          "GL005", "GL006", "GL007", "GL008"}
+                          "GL005", "GL006", "GL007", "GL008", "GL009"}
     assert len(RULES_BY_NAME) == len(RULES), "duplicate rule names"
     for rule in RULES.values():
         assert rule.summary and rule.rationale and rule.fix
@@ -123,6 +124,30 @@ def test_method_form_block_until_ready_flagged_in_hot_loop():
         "    return state\n")
     assert any(f.rule.id == "GL001" and "block_until_ready" in f.message
                for f in lint_source(src))
+
+
+def test_phantom_mesh_axis_detector():
+    """GL009 (ISSUE 6): a typo'd PartitionSpec axis inside
+    with_sharding_constraint traces fine and silently replicates — the
+    lint must flag axes no mesh declares, accept the canonical
+    data/model axes, and accept axes a Mesh() in the same module
+    declares."""
+    bad = ("import jax\nfrom jax.sharding import PartitionSpec as P\n"
+           "def f(x):\n"
+           "    return jax.lax.with_sharding_constraint(x, P('modle'))\n")
+    assert [f.rule.id for f in lint_source(bad)] == ["GL009"]
+    ok = ("import jax\nfrom jax.sharding import PartitionSpec as P\n"
+          "def f(x):\n"
+          "    return jax.lax.with_sharding_constraint(x, "
+          "P('data', 'model'))\n")
+    assert lint_source(ok) == []
+    # an exotic axis is fine once a Mesh in the module declares it
+    exotic = ("import jax\n"
+              "from jax.sharding import Mesh, PartitionSpec as P\n"
+              "mesh = Mesh(devs, ('expert', 'data'))\n"
+              "def f(x):\n"
+              "    return jax.lax.with_sharding_constraint(x, P('expert'))\n")
+    assert lint_source(exotic) == []
 
 
 def test_repo_hot_path_lints_clean():

@@ -388,6 +388,27 @@ class TestObsReport:
         assert code == 1
         assert "[FAIL] step_ms_p50" in out
 
+    def test_gate_notes_cross_layout_compare(self, tmp_path):
+        # ISSUE 6: 1-D vs 2-D runs ARE comparable (that IS the point of
+        # the mesh/map-hash fields), but the report must attribute the
+        # layout difference instead of reading it as a plain regression
+        base = tmp_path / "base.json"
+        base.write_text(json.dumps({**_serve_doc(), "mesh": "8 (data)"}))
+        cur = tmp_path / "cur.json"
+        cur.write_text(json.dumps({**_serve_doc(),
+                                   "mesh": "4x2 (data,model)",
+                                   "sharding_map_hash": "abc123def456"}))
+        code, out = _run_report("--check", str(cur),
+                                "--baseline", str(base))
+        assert code == 0, out
+        assert "[note] mesh differs: baseline 8 (data) -> current " \
+               "4x2 (data,model)" in out
+        assert "[note] sharding_map_hash differs" in out
+        # identical layouts stay note-free
+        code, out = _run_report("--check", str(base),
+                                "--baseline", str(base))
+        assert code == 0 and "[note]" not in out
+
     def test_incomparable_artifacts_fail_loudly(self, tmp_path):
         empty = tmp_path / "empty.jsonl"
         empty.write_text(json.dumps({"kind": "event", "name": "e",

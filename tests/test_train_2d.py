@@ -1,0 +1,283 @@
+"""2-D ``(data, model)`` FSDP training gates (ISSUE 6).
+
+Loss parity pins the tentpole's semantics: the 4x2 FSDP grid, the 1-D
+8-way data mesh, and a single device must train IDENTICALLY to float
+tolerance for both loss families — the sharding map changes where bytes
+live and which collectives move them, never the math.  The grad-cache
+path gets the same pin (4x2 M=2 == 8-way M=2: a microbatch is a virtual
+shard, so the virtual-shard census must match, not the mesh shape).
+
+The acceptance gates are here too: a 2-step ``run_training`` on the 4x2
+grid completes under the loop's own ``transfer_guard("disallow")`` with
+large params VERIFIABLY sharded (per-shard byte accounting on the live
+TrainState, not just specs), the direct 2-D step runs twice on one
+jit-cache entry under an explicit guard, and a 1-D checkpoint resumes
+onto the 2-D mesh and back (MIGRATING.md "Checkpoint resharding").
+
+Pinned tier-1 (never @slow) by tests/test_suite_hygiene.py: these are
+the regression fence for the pod-scale layout.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from milnce_tpu.config import LossConfig, OptimConfig, ParallelConfig, tiny_preset
+from milnce_tpu.models import S3D
+from milnce_tpu.parallel.mesh import build_mesh, batch_sharding, replicate_to_mesh
+from milnce_tpu.parallel.sharding_map import (place_tree, sharded_count,
+                                              sharded_dim, spec_leaves,
+                                              state_partition_specs)
+from milnce_tpu.train.schedule import build_schedule
+from milnce_tpu.train.state import (build_optimizer, create_train_state,
+                                    per_device_state_bytes)
+from milnce_tpu.train.step import make_grad_cache_step, make_train_step
+
+# Tiny-entry geometry (mirrors analysis/trace_invariants.py _setup): 16
+# clips = 2 per shard on every 8-shard layout below; threshold 256 so
+# several kernels actually shard on the 2-wide model axis.
+_B, _FRAMES, _SIZE, _WORDS, _VOCAB = 16, 4, 32, 5, 32
+_MIN_SIZE = 256
+
+
+def _model(bn_axes):
+    # sync BN over the mesh's batch axes: makes normalization a function
+    # of the GLOBAL batch, so the single-device run (whole batch, no
+    # axis) is comparable with every sharded layout
+    return S3D(num_classes=16, vocab_size=_VOCAB, word_embedding_dim=8,
+               text_hidden_dim=16, inception_blocks=1, bn_axis_name=bn_axes)
+
+
+def _batch(seed=0):
+    rng = np.random.default_rng(seed)
+    video = rng.integers(0, 255, (_B, _FRAMES, _SIZE, _SIZE, 3),
+                         dtype=np.uint8)
+    text = rng.integers(0, _VOCAB, (_B, _WORDS)).astype(np.int32)
+    start = np.zeros((_B,), np.float32)
+    return video, text, start
+
+
+def _mesh(kind):
+    if kind == "single":
+        return build_mesh(ParallelConfig(), devices=jax.devices()[:1])
+    if kind == "1d":
+        return build_mesh(ParallelConfig())
+    return build_mesh(ParallelConfig(model_axis="model",
+                                     model_parallel_size=2))
+
+
+def _train(kind, loss_cfg=None, n_steps=2, grad_accum=1):
+    """Fresh init (same PRNG key on every layout) -> n_steps of the real
+    step program on the ``kind`` mesh; returns per-step losses and the
+    final state."""
+    mesh = _mesh(kind)
+    fsdp = kind == "2d"
+    bn_axes = (("data", "model") if fsdp else "data")
+    model = _model(bn_axes)
+    variables = model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((2, _FRAMES, _SIZE, _SIZE, 3), jnp.float32),
+        jnp.zeros((2, _WORDS), jnp.int32))
+    opt = build_optimizer(OptimConfig(warmup_steps=2),
+                          build_schedule(OptimConfig(warmup_steps=2), 10))
+    state = create_train_state(variables, opt)
+    if fsdp:
+        specs = state_partition_specs(state, mesh, "model",
+                                      min_size=_MIN_SIZE)
+        assert sharded_count(specs.params, "model") > 0
+        state = place_tree(state, specs, mesh)
+    else:
+        specs = None
+        state = replicate_to_mesh(state, mesh)
+    kw = dict(donate=False, loss_cfg=loss_cfg, state_specs=specs,
+              model_axis="model" if fsdp else None)
+    if grad_accum > 1:
+        step = make_grad_cache_step(model, opt, mesh, grad_accum, **kw)
+    else:
+        step = make_train_step(model, opt, mesh, **kw)
+    losses = []
+    for i in range(n_steps):
+        state, loss = step(state, *_batch(i))
+        losses.append(float(loss))
+    return losses, state
+
+
+# --------------------------------------------------------------------------
+# loss parity: 2-D == 1-D == single device, both loss families
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("loss_cfg", [
+    None,                                             # milnce
+    LossConfig(name="sdtw_3", sdtw_backend="scan"),   # DTW family
+], ids=["milnce", "sdtw_3"])
+def test_mesh_layout_parity(loss_cfg):
+    """Two full optimizer steps agree across layouts: step-2 loss is a
+    function of step-1's update, so agreement transitively pins grads,
+    the FSDP gather/reduce-scatter pair, and the optimizer running on
+    local shards — not just the forward."""
+    ref, _ = _train("single", loss_cfg)
+    one_d, _ = _train("1d", loss_cfg)
+    two_d, _ = _train("2d", loss_cfg)
+    np.testing.assert_allclose(one_d, ref, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(two_d, ref, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(two_d, one_d, rtol=2e-4, atol=2e-5)
+
+
+def test_grad_cache_parity_2d_vs_1d():
+    """The once-per-step-reduction grad-cache program is mesh-layout
+    invariant: 4x2 M=2 == 8-way M=2, microbatch census identical (BN
+    sees the same virtual shards), losses equal to float tolerance.
+    Final params agree leaf-for-leaf — the 2-D run's optimizer only
+    ever saw LOCAL shards of grads and moments, so equality here is
+    the end-to-end FSDP correctness pin."""
+    one_d, st1 = _train("1d", grad_accum=2)
+    two_d, st2 = _train("2d", grad_accum=2)
+    np.testing.assert_allclose(two_d, one_d, rtol=2e-4, atol=2e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(st1.params),
+                    jax.tree_util.tree_leaves(st2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+# --------------------------------------------------------------------------
+# acceptance: transfer guard + zero recompiles + real byte accounting
+# --------------------------------------------------------------------------
+
+def _assert_state_bytes_match_specs(state, specs, mesh):
+    """Per-shard byte accounting asserted on COMMITTED arrays: every
+    device holds exactly (replicated bytes + sharded bytes / axis size)
+    — specs claiming FSDP while bytes stay replicated would fail here."""
+    axis_size = mesh.shape["model"]
+    expect = 0
+    for leaf, sp in zip(jax.tree_util.tree_leaves(state),
+                        spec_leaves(specs)):
+        n = leaf.nbytes if hasattr(leaf, "nbytes") else np.asarray(leaf).nbytes
+        expect += n // axis_size if sharded_dim(sp, "model") is not None else n
+    per_dev = per_device_state_bytes(state)
+    assert len(per_dev) == 8
+    for dev, got in per_dev.items():
+        assert got == expect, (dev, got, expect)
+    replicated = sum(
+        leaf.nbytes for leaf in jax.tree_util.tree_leaves(state))
+    assert expect < replicated   # the map sharded something real
+
+
+def test_2d_step_zero_recompiles_under_transfer_guard():
+    """Direct twin of the acceptance criterion: two 2-D steps with
+    fresh batches run under ``transfer_guard("disallow")`` (all inputs
+    explicitly placed) on ONE jit-cache entry."""
+    mesh = _mesh("2d")
+    model = _model(("data", "model"))
+    variables = model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((2, _FRAMES, _SIZE, _SIZE, 3), jnp.float32),
+        jnp.zeros((2, _WORDS), jnp.int32))
+    opt = build_optimizer(OptimConfig(warmup_steps=2),
+                          build_schedule(OptimConfig(warmup_steps=2), 10))
+    state = create_train_state(variables, opt)
+    specs = state_partition_specs(state, mesh, "model", min_size=_MIN_SIZE)
+    state = place_tree(state, specs, mesh)
+    _assert_state_bytes_match_specs(state, specs, mesh)
+    step = make_train_step(model, opt, mesh, donate=False,
+                           state_specs=specs, model_axis="model")
+    data_sh = batch_sharding(mesh, ("data", "model"))
+
+    def place(seed):
+        video, text, start = _batch(seed)
+        return (jax.device_put(video, data_sh), jax.device_put(text, data_sh),
+                jax.device_put(start, data_sh))
+
+    args = [place(0), place(1)]
+    with jax.transfer_guard("disallow"):
+        for a in args:
+            state, loss = step(state, *a)
+    assert np.isfinite(jax.device_get(loss))
+    # the updated state is STILL sharded: the step's out_specs keep the
+    # FSDP layout, no silent re-replication after one update
+    _assert_state_bytes_match_specs(state, specs, mesh)
+    if hasattr(step, "_cache_size"):
+        assert step._cache_size() == 1, step._cache_size()
+
+
+def _run_cfg(tmp_path, name, two_d):
+    cfg = tiny_preset()
+    cfg.model.inception_blocks = 1
+    cfg.train.batch_size = 8
+    cfg.data.synthetic_num_samples = 32
+    cfg.data.num_reader_threads = 2
+    cfg.train.checkpoint_root = str(tmp_path / "ckpt")   # shared: resume
+    cfg.train.log_root = str(tmp_path / f"log_{name}")
+    if two_d:
+        cfg.parallel.model_axis = "model"
+        cfg.parallel.model_parallel_size = 2
+        cfg.parallel.fsdp_min_size = _MIN_SIZE
+    return cfg
+
+
+def test_model_axis_without_size_refuses_loudly(tmp_path):
+    """--parallel.model_axis set but model_parallel_size left at 1 must
+    be an error, not a silent 1-D run the config claims is FSDP (the
+    same refuse-loudly rule as GL009 / bench's shards-NOTHING)."""
+    from milnce_tpu.train.loop import run_training
+
+    cfg = _run_cfg(tmp_path, "phantom", two_d=True)
+    cfg.parallel.model_parallel_size = 1
+    with pytest.raises(ValueError, match="model_parallel_size"):
+        run_training(cfg, max_steps=1)
+
+
+def test_run_training_2d_two_steps_sharded(tmp_path):
+    """The loop-level acceptance run: 2 steps on the 4x2 grid through
+    ``run_training`` (its own steady-state transfer guard armed), the
+    returned live state carrying real model-axis shards."""
+    from milnce_tpu.train.loop import run_training
+
+    cfg = _run_cfg(tmp_path, "accept", two_d=True)
+    res = run_training(cfg, max_steps=2)
+    assert res.steps == 2
+    assert np.isfinite(res.last_loss)
+    mesh = _mesh("2d")
+    specs = state_partition_specs(res.state, mesh, "model",
+                                  min_size=_MIN_SIZE)
+    _assert_state_bytes_match_specs(res.state, specs, mesh)
+
+
+# --------------------------------------------------------------------------
+# checkpoint resharding round trip: 1-D -> 2-D -> 1-D
+# --------------------------------------------------------------------------
+
+def test_resume_1d_checkpoint_onto_2d_mesh_and_back(tmp_path):
+    """A checkpoint carries global arrays, never a mesh layout
+    (MIGRATING.md): a 1-D run's checkpoint resumes onto the 4x2 FSDP
+    grid (state resharded through the loop's single placement path,
+    step counter carried, update applied on local shards) and THAT
+    run's checkpoint opens back on the 1-D mesh."""
+    from milnce_tpu.train.loop import run_training
+
+    r1 = run_training(_run_cfg(tmp_path, "seed1d", two_d=False),
+                      max_steps=2)
+
+    cfg2 = _run_cfg(tmp_path, "to2d", two_d=True)
+    cfg2.train.resume = True
+    cfg2.optim.epochs = 2
+    r2 = run_training(cfg2, max_steps=1)
+    assert int(r2.state.step) == int(r1.state.step) + 1
+    assert np.isfinite(r2.last_loss)
+    mesh = _mesh("2d")
+    specs = state_partition_specs(r2.state, mesh, "model",
+                                  min_size=_MIN_SIZE)
+    _assert_state_bytes_match_specs(r2.state, specs, mesh)
+
+    cfg3 = _run_cfg(tmp_path, "back1d", two_d=False)
+    cfg3.train.resume = True
+    cfg3.optim.epochs = 3
+    r3 = run_training(cfg3, max_steps=1)
+    assert int(r3.state.step) == int(r2.state.step) + 1
+    assert np.isfinite(r3.last_loss)
+    # back on the data mesh every leaf is fully replicated again
+    per_dev = per_device_state_bytes(r3.state)
+    replicated = sum(
+        leaf.nbytes for leaf in jax.tree_util.tree_leaves(r3.state))
+    assert set(per_dev.values()) == {replicated}
