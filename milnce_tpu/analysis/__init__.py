@@ -8,15 +8,25 @@ undonated buffers):
 - :mod:`milnce_tpu.analysis.astlint` — pure-AST lint (no jax import) with
   JAX-specific rules (:mod:`milnce_tpu.analysis.rules`) and an inline
   ``# graftlint: disable=RULE(reason)`` suppression syntax, so audited
-  exceptions stay documented instead of silenced;
+  exceptions stay documented instead of silenced (stale suppressions are
+  themselves findings);
 - :mod:`milnce_tpu.analysis.trace_invariants` — traces the registered
   entry points (train step variants, soft-DTW ops, eval retrieval) under
   a CPU mesh and asserts jaxpr-level invariants: no float64 anywhere,
   the expected collective count per step, identical param treedefs
-  across conv impls, and a double-call recompile detector.
+  across conv impls, and a double-call recompile detector;
+- :mod:`milnce_tpu.analysis.concurrency` — Pass 3a: lock-discipline lint
+  for the serving/obs thread mesh (GL010 unguarded shared state, GL011
+  lock-order cycles, GL012 blocking under a lock), with ``# guarded-by:``
+  annotations and an inferred per-class guard map (SERVING.md "Threading
+  model");
+- :mod:`milnce_tpu.analysis.lockrt` — Pass 3b: the runtime twin, an
+  opt-in order-checking ``SanitizedLock`` (``MILNCE_LOCK_SANITIZE=1``)
+  that raises on ABBA cycles, self-deadlocks and blown hold budgets.
 
 CLI: ``scripts/graft_lint.py`` (writes LINT.md; ``--check`` exits
-nonzero on findings).  Rule catalogue: ANALYSIS.md.
+nonzero on findings; ``--no-concurrency`` skips Pass 3).  Rule
+catalogue: ANALYSIS.md.
 """
 
 from milnce_tpu.analysis.rules import RULES, Rule  # noqa: F401

@@ -674,28 +674,79 @@ def _parent_functions(tree: ast.Module) -> dict:
     return out
 
 
-def lint_source(src: str, path: str = "<string>") -> list[Finding]:
-    """All findings for one module, suppressions applied (suppressed
-    findings are RETURNED with .suppressed=True so reports can list the
-    audited exceptions; callers gate on the unsuppressed subset)."""
-    sups, bad = parse_suppressions(src, path)
-    findings = _ModuleLint(src, path).run()
+# Rules whose findings a suppression can legitimately absorb, per pass.
+# Staleness (GL000) is only judged against rules that actually RAN: a
+# GL010 suppression is not "stale" under --no-concurrency, it is simply
+# unevaluated this invocation.  GL011 is NEVER staleness-judged: a
+# cycle's partner edge may live in a module outside the current lint
+# scope (a `graft_lint milnce_tpu/serving` narrowed run must not call a
+# cross-module cycle's audited suppression stale).
+_PASS1_RULES = frozenset({"GL001", "GL002", "GL003", "GL004", "GL005",
+                          "GL006", "GL007", "GL008", "GL009"})
+_PASS3_STALE_RULES = frozenset({"GL010", "GL012"})
+
+
+def _finalize(findings: list[Finding], sups: list[Suppression],
+              bad: list[Finding], path: str,
+              evaluated: frozenset) -> list[Finding]:
+    """Apply suppressions to one file's findings, then turn every
+    well-formed suppression that matched NOTHING (for a rule that was
+    evaluated) into a GL000 stale-suppression finding — the audited-
+    exceptions table in LINT.md must never claim exceptions that no
+    longer exist."""
     by_line: dict[tuple[int, str], Suppression] = {}
     for s in sups:
         target = s.line + 1 if s.standalone else s.line
         by_line[(target, s.rule_id)] = s
+    matched: set = set()
     for f in findings:
         s = by_line.get((f.line, f.rule.id))
         if s is not None:
             f.suppressed = True
             f.suppress_reason = s.reason
+            matched.add((f.line, f.rule.id))
+    for (line, rule_id), s in by_line.items():
+        if (line, rule_id) in matched or rule_id not in evaluated:
+            continue
+        findings.append(Finding(
+            path, s.line, RULES["GL000"],
+            f"stale suppression: {rule_id} no longer fires on this line "
+            "— delete it (or re-audit why you expected it to fire)"))
     findings.extend(bad)
     findings.sort(key=lambda f: (f.path, f.line, f.rule.id))
     return findings
 
 
-def lint_paths(paths: list[str]) -> list[Finding]:
-    """Lint every .py under the given files/directories.
+def _lint_one(src: str, path: str, concurrency: bool):
+    """One file's raw findings + suppressions + (optional) lock graph,
+    BEFORE suppression matching (GL011 needs the graphs of every file
+    in scope merged first)."""
+    sups, bad = parse_suppressions(src, path)
+    findings = _ModuleLint(src, path).run()
+    graph = None
+    if concurrency:
+        from milnce_tpu.analysis.concurrency import lint_concurrency_source
+
+        cfindings, graph, _reports = lint_concurrency_source(src, path)
+        findings.extend(cfindings)
+    return findings, sups, bad, graph
+
+
+def lint_source(src: str, path: str = "<string>", *,
+                concurrency: bool = True) -> list[Finding]:
+    """All findings for one module, suppressions applied (suppressed
+    findings are RETURNED with .suppressed=True so reports can list the
+    audited exceptions; callers gate on the unsuppressed subset).
+    ``concurrency=False`` skips Pass 3 (GL010-GL012)."""
+    findings, sups, bad, graph = _lint_one(src, path, concurrency)
+    if graph is not None:
+        findings.extend(graph.cycle_findings())
+    evaluated = _PASS1_RULES | (_PASS3_STALE_RULES if concurrency else frozenset())
+    return _finalize(findings, sups, bad, path, evaluated)
+
+
+def _discover_files(paths: list[str]) -> list[str]:
+    """Every .py under the given files/directories, sorted.
 
     A path that matches no Python files raises instead of being
     silently dropped — a typo'd scope argument must fail the gate
@@ -716,8 +767,49 @@ def lint_paths(paths: list[str]) -> list[Finding]:
                 f"lint scope {p!r} matches no Python files — typo'd path? "
                 "(a silently empty scope would pass the gate vacuously)")
         files.extend(found)
-    out: list[Finding] = []
-    for fname in sorted(files):
+    return sorted(files)
+
+
+def lint_paths_full(paths: list[str], *, concurrency: bool = True):
+    """Lint every .py under the given files/directories.
+
+    Returns ``(findings, lock_graph)`` where ``lock_graph`` is the
+    MERGED cross-module lock-order graph (None when ``concurrency``
+    is off) — GL011 cycles split across files (A->B in one module,
+    B->A in another, joined by an imported lock) only exist in the
+    union."""
+    per_file = []
+    merged = None
+    for fname in _discover_files(paths):
         with open(fname) as fh:
-            out.extend(lint_source(fh.read(), fname))
-    return out
+            findings, sups, bad, graph = _lint_one(fh.read(), fname,
+                                                   concurrency)
+        per_file.append((fname, findings, sups, bad))
+        if graph is not None:
+            if merged is None:
+                from milnce_tpu.analysis.concurrency import LockGraph
+
+                merged = LockGraph()
+            merged.merge(graph)
+    cycle_by_path: dict[str, list] = {}
+    if merged is not None:
+        for f in merged.cycle_findings():
+            cycle_by_path.setdefault(f.path, []).append(f)
+    evaluated = _PASS1_RULES | (_PASS3_STALE_RULES if concurrency else frozenset())
+    out: list[Finding] = []
+    for fname, findings, sups, bad in per_file:
+        findings.extend(cycle_by_path.pop(fname, []))
+        out.extend(_finalize(findings, sups, bad, fname, evaluated))
+    # cycles anchored outside the scanned files (can't happen today —
+    # anchors are always edge sites in scope — but never drop findings)
+    for leftovers in cycle_by_path.values():
+        out.extend(leftovers)
+    out.sort(key=lambda f: (f.path, f.line, f.rule.id))
+    return out, merged
+
+
+def lint_paths(paths: list[str], *,
+               concurrency: bool = True) -> list[Finding]:
+    """:func:`lint_paths_full` without the graph (the common caller)."""
+    findings, _graph = lint_paths_full(paths, concurrency=concurrency)
+    return findings

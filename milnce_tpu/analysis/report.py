@@ -19,7 +19,7 @@ HEADER = ("<!-- (auto-written by scripts/graft_lint.py — do not hand-edit; "
 
 
 def render_report(findings: list[Finding], trace_results=None,
-                  paths=None) -> str:
+                  paths=None, lock_graph=None) -> str:
     active = [f for f in findings if not f.suppressed]
     suppressed = [f for f in findings if f.suppressed]
     lines = [HEADER, "# graftlint report", ""]
@@ -27,7 +27,7 @@ def render_report(findings: list[Finding], trace_results=None,
         lines.append(f"Scope: `{'`, `'.join(paths)}`")
         lines.append("")
 
-    lines.append("## Pass 1 — AST lint")
+    lines.append("## Pass 1 + Pass 3 — AST lint (rules + concurrency)")
     lines.append("")
     lines.append(f"- findings: **{len(active)}**")
     lines.append(f"- audited suppressions in force: {len(suppressed)}")
@@ -48,6 +48,25 @@ def render_report(findings: list[Finding], trace_results=None,
             lines.append(f"| `{f.path}:{f.line}` | {f.rule.id} "
                          f"({f.rule.name}) | {f.suppress_reason} |")
         lines.append("")
+
+    lines.append("## Pass 3 — lock-order graph")
+    lines.append("")
+    if lock_graph is None:
+        lines.append("(skipped — run without `--no-concurrency` for the "
+                     "lock-discipline pass)")
+    else:
+        edges = sorted((u, v, site) for (u, v), site
+                       in lock_graph.edges.items())
+        lines.append(f"- locks in the acquisition graph: "
+                     f"{len(lock_graph.locks)}; ordering edges: "
+                     f"{len(edges)}; cycles fail as GL011 findings above")
+        if edges:
+            lines.append("")
+            lines.append("| held | acquired | first site |")
+            lines.append("|---|---|---|")
+            for u, v, (path, line) in edges:
+                lines.append(f"| `{u}` | `{v}` | `{path}:{line}` |")
+    lines.append("")
 
     lines.append("## Pass 2 — trace invariants")
     lines.append("")

@@ -31,13 +31,22 @@ _RULE_LIST = (
     Rule(
         id="GL000",
         name="bad-suppression",
-        summary="malformed graftlint suppression comment",
+        summary="malformed or stale graftlint suppression / annotation "
+                "comment",
         rationale="A suppression without a reason (or naming an unknown "
                   "rule) silences findings without documenting why; the "
                   "whole point of the inline syntax is that every audited "
-                  "exception carries its audit.",
+                  "exception carries its audit.  A STALE suppression — one "
+                  "whose rule no longer fires on that line — is the same "
+                  "rot in reverse: the audited-exceptions table in LINT.md "
+                  "claims an exception that no longer exists, and a later "
+                  "real finding on that line would be silently absorbed.  "
+                  "Ditto a `# guarded-by:` annotation naming a lock the "
+                  "module doesn't declare.",
         example="x = float(loss)  # graftlint: disable=GL001",
-        fix="write `# graftlint: disable=GL001(<why this sync is safe>)`",
+        fix="write `# graftlint: disable=GL001(<why this sync is safe>)`; "
+            "delete suppressions whose rule stopped firing (or re-audit "
+            "why you expected it to); fix typo'd guarded-by lock names",
     ),
     Rule(
         id="GL001",
@@ -174,6 +183,77 @@ _RULE_LIST = (
             "axis_name= kwarg in the same module); a deliberate "
             "foreign-mesh constraint gets "
             "# graftlint: disable=GL009(<which mesh declares it>)",
+    ),
+    Rule(
+        id="GL010",
+        name="unguarded-shared-state",
+        summary="shared mutable attribute accessed outside its lock in a "
+                "thread-shared class",
+        rationale="The serving/obs layers are a thread mesh: batcher "
+                  "worker, HTTP request threads, data readers and the "
+                  "train loop share per-class state behind ad-hoc locks. "
+                  "A write outside the attribute's guard (or with no "
+                  "guard at all) is a data race — lost counter "
+                  "increments, dict-changed-size crashes mid-/healthz, "
+                  "the exact bugs three of the last four PRs fixed by "
+                  "hand after review.  Lock-free READS of a guarded "
+                  "attribute are equally racy unless the attribute is "
+                  "write-once in __init__ (the audited tokenizer "
+                  "pattern: publish-then-read-only is safe under the "
+                  "GIL's reference semantics).",
+        example="self._calls[key] = self._calls.get(key, 0) + 1  "
+                "# no lock; called from worker AND request threads",
+        fix="take the guard (`with self._lock:`) around every access; "
+            "declare the guard explicitly with `# guarded-by: _lock` on "
+            "the __init__ assignment when inference can't see it; a "
+            "deliberate lock-free read of a write-once attribute is "
+            "already exempt — anything else needs a reasoned "
+            "suppression",
+    ),
+    Rule(
+        id="GL011",
+        name="lock-order-cycle",
+        summary="cycle in the static lock-acquisition order graph",
+        rationale="If thread 1 takes A then B while thread 2 takes B "
+                  "then A, some interleaving deadlocks — whether or not "
+                  "today's tests hit it.  The lint builds the "
+                  "acquisition graph (lock held -> lock acquired, "
+                  "through same-module calls and across modules via "
+                  "imported module-level locks like "
+                  "DEVICE_DISPATCH_LOCK) and fails on any cycle, so a "
+                  "deadlock-shaped ordering is a tier-1 failure at "
+                  "review time, not a wedged pod at 3am.  The runtime "
+                  "twin (analysis/lockrt.SanitizedLock) enforces the "
+                  "same discipline on live threads.",
+        example="# thread 1: with A: with B: ...\n"
+                "# thread 2: with B: with A: ...",
+        fix="pick ONE global order for the locks involved and acquire "
+            "in that order everywhere (narrow critical sections until "
+            "nesting disappears is even better); a provably-safe "
+            "ordering the analysis can't see gets "
+            "# graftlint: disable=GL011(<why no interleaving deadlocks>)",
+    ),
+    Rule(
+        id="GL012",
+        name="blocking-under-lock",
+        summary="blocking call (future.result/join/wait/open/sleep or "
+                "device dispatch) while holding a lock",
+        rationale="A lock held across a blocking call stalls EVERY "
+                  "contender for the full wait: request threads pile up "
+                  "behind one file open, one future, one device "
+                  "dispatch.  Worse, blocking on work that needs another "
+                  "lock-holder to finish (future.result under a lock "
+                  "the worker also takes) is a deadlock with extra "
+                  "steps.  Device dispatch is exempt ONLY under locks "
+                  "whose name contains 'dispatch' — serializing device "
+                  "work is DEVICE_DISPATCH_LOCK's entire job; anything "
+                  "else blocking under it still fires.",
+        example="with self._lock:\n    row = fut.result()",
+        fix="move the blocking work outside the critical section (copy "
+            "state under the lock, block after release — the "
+            "kill_inflight_decoders pattern); a deliberate "
+            "block-under-lock gets "
+            "# graftlint: disable=GL012(<why contenders may wait>)",
     ),
 )
 

@@ -26,12 +26,12 @@ from __future__ import annotations
 
 import csv as csv_mod
 import os
-import threading
 from collections import OrderedDict
 from typing import Optional
 
 import numpy as np
 
+from milnce_tpu.analysis.lockrt import make_lock
 from milnce_tpu.config import DataConfig, ModelConfig
 from milnce_tpu.obs import metrics as obs_metrics
 from milnce_tpu.data.captions import CaptionTrack, sample_caption
@@ -100,10 +100,10 @@ class HowTo100MSource:
         self.decoder = decoder
         self.tokenizer = tokenizer or build_tokenizer(model_cfg, cfg.max_words)
         self._caption_cache: "OrderedDict[str, CaptionTrack]" = OrderedDict()
-        self._cache_lock = threading.Lock()
+        self._cache_lock = make_lock("data.caption_cache")
         self.decode_failures = 0
         self.decode_attempts = 0
-        self._stats_lock = threading.Lock()
+        self._stats_lock = make_lock("data.decode_stats")
         # failure details route through the run's logger when the loop
         # provides it (satellite: no raw stderr prints from the source);
         # standalone uses keep the stderr default

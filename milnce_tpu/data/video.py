@@ -26,12 +26,12 @@ from __future__ import annotations
 
 import shutil
 import subprocess
-import threading
 from dataclasses import dataclass
 from typing import Protocol
 
 import numpy as np
 
+from milnce_tpu.analysis.lockrt import make_lock
 from milnce_tpu.resilience import faults
 
 
@@ -43,7 +43,7 @@ from milnce_tpu.resilience import faults
 # the pipe read; ShardedLoader's generator close calls
 # :func:`kill_inflight_decoders`.
 _INFLIGHT: set = set()
-_INFLIGHT_LOCK = threading.Lock()
+_INFLIGHT_LOCK = make_lock("data.video.inflight")
 
 
 def _register_inflight(proc) -> None:

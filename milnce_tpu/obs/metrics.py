@@ -29,14 +29,18 @@ Hard invariants:
   counts under contention.
 
 No jax, no numpy — pure stdlib, importable anywhere (including the
-jax-free AST lint pass).
+jax-free AST lint pass).  Every lock is created through
+``analysis.lockrt.make_lock``, so ``MILNCE_LOCK_SANITIZE=1`` swaps in
+the order-checking :class:`~milnce_tpu.analysis.lockrt.SanitizedLock`
+across the whole registry (ANALYSIS.md, Pass 3b).
 """
 
 from __future__ import annotations
 
 import bisect
-import threading
 from typing import Callable, Optional, Sequence
+
+from milnce_tpu.analysis.lockrt import make_lock
 
 METRIC_TYPES = ("counter", "gauge", "histogram")
 
@@ -60,7 +64,7 @@ class Counter:
     __slots__ = ("_lock", "_value")
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.metrics.counter")
         self._value = 0.0
 
     def inc(self, amount: float = 1.0) -> None:
@@ -78,28 +82,36 @@ class Counter:
 
 class Gauge:
     """Set-to-current-value child; ``fn`` makes it collect-time computed
-    (reads delegate to the callback, ``set`` becomes an error)."""
+    (reads delegate to the callback, ``set`` becomes an error).
+
+    ``_fn`` shares ``_value``'s guard: ``bind()`` arrives from component
+    (re)construction while scrape threads read — an unlocked swap raced
+    both (graftlint GL010).  The callback itself is invoked OUTSIDE the
+    lock: callbacks read other components' stats (engine recompiles,
+    cache hit rate) that take their own locks, and calling through while
+    holding ours would put this gauge's lock above every one of theirs
+    in the order graph for no benefit (GL012 discipline)."""
 
     __slots__ = ("_lock", "_value", "_fn")
 
     def __init__(self, fn: Optional[Callable[[], float]] = None):
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.metrics.gauge")
         self._value = 0.0
         self._fn = fn
 
     def set(self, value: float) -> None:
-        if self._fn is not None:
-            raise ValueError("callback gauge: the value comes from its "
-                             "fn at collect time, set() is meaningless")
         value = _host_number(value)
         with self._lock:
+            if self._fn is not None:
+                raise ValueError("callback gauge: the value comes from its "
+                                 "fn at collect time, set() is meaningless")
             self._value = value
 
     def inc(self, amount: float = 1.0) -> None:
         amount = _host_number(amount)
-        if self._fn is not None:
-            raise ValueError("callback gauge cannot be incremented")
         with self._lock:
+            if self._fn is not None:
+                raise ValueError("callback gauge cannot be incremented")
             self._value += amount
 
     def dec(self, amount: float = 1.0) -> None:
@@ -109,17 +121,19 @@ class Gauge:
         """(Re)bind the collect-time callback — create-or-get semantics
         mean a long-lived registry may outlive the object a callback
         reads; the latest binding wins."""
-        self._fn = fn
+        with self._lock:
+            self._fn = fn
 
     @property
     def value(self) -> float:
-        if self._fn is not None:
-            # callbacks go through the same host-side-only boundary as
-            # set(): a callback returning a device array would otherwise
-            # smuggle a blocking sync into every scrape/snapshot
-            return _host_number(self._fn())
         with self._lock:
-            return self._value
+            fn = self._fn
+            if fn is None:
+                return self._value
+        # callbacks go through the same host-side-only boundary as
+        # set(): a callback returning a device array would otherwise
+        # smuggle a blocking sync into every scrape/snapshot
+        return _host_number(fn())
 
 
 class Histogram:
@@ -138,7 +152,7 @@ class Histogram:
             raise ValueError(f"histogram edges must be non-empty and "
                              f"strictly ascending, got {edges}")
         self.edges = edges
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.metrics.histogram")
         self._counts = [0] * (len(edges) + 1)
         self._sum = 0.0
         self._count = 0
@@ -179,7 +193,7 @@ class Family:
         self.help = help
         self.labelnames = tuple(labelnames)
         self.edges = tuple(edges)
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.metrics.family")
         self._children: dict[tuple, object] = {}
         if not self.labelnames:          # unlabeled: materialize the child
             self.labels()
@@ -223,7 +237,7 @@ class MetricsRegistry:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.metrics.registry")
         self._families: dict[str, Family] = {}
 
     def _family(self, name: str, mtype: str, help: str, labels: tuple,

@@ -32,10 +32,11 @@ from __future__ import annotations
 
 import contextlib
 import json
-import threading
 import time
 from collections import deque
 from typing import Optional
+
+from milnce_tpu.analysis.lockrt import make_lock
 
 
 def _now() -> float:
@@ -54,7 +55,7 @@ class SpanRecorder:
         self.path = path or None
         self.profiler_bridge = bool(profiler_bridge)
         self._ring: deque = deque(maxlen=max(1, int(ring)))
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.spans.recorder")
         self._fh = None
         if self.path:
             # line-buffered append handle, opened ONCE (the RunLogger
@@ -130,7 +131,7 @@ class SpanRecorder:
 # ---------------------------------------------------------------------------
 
 _default = SpanRecorder()           # ring-only until a run installs a file
-_install_lock = threading.Lock()
+_install_lock = make_lock("obs.spans.install")
 
 
 def get_recorder() -> SpanRecorder:

@@ -44,11 +44,11 @@ step unless armed at build time.
 from __future__ import annotations
 
 import os
-import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
+from milnce_tpu.analysis.lockrt import make_lock
 from milnce_tpu.obs import metrics as obs_metrics
 
 KNOWN_SITES = ("decode.raise", "decode.hang", "ckpt.save_ioerror",
@@ -127,7 +127,7 @@ def parse_spec(spec: str) -> dict[str, SiteSpec]:
 class FaultRegistry:
     def __init__(self, spec: str):
         self.sites = parse_spec(spec)
-        self._lock = threading.Lock()
+        self._lock = make_lock("resilience.faults")
 
     def fire(self, site: str) -> SiteSpec | None:
         """Count one occurrence of ``site``; return its spec if this

@@ -44,6 +44,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from milnce_tpu.analysis.lockrt import make_lock
 from milnce_tpu.obs import metrics as obs_metrics
 from milnce_tpu.obs import spans as obs_spans
 
@@ -160,9 +161,11 @@ class DynamicBatcher:
         # totals — isolation is a private registry (the default) or a
         # distinct name, not this cache.  Lock-guarded: the worker
         # inserts on a bucket's first flush while request threads
-        # iterate it in stats() (/healthz)
+        # iterate it in stats() (/healthz) — EVERY access, including the
+        # worker's own lookup (graftlint GL010: single-writer does not
+        # make a lock-free read of a guarded dict safe)
         self._bucket_children: dict[int, tuple] = {}
-        self._children_lock = threading.Lock()
+        self._children_lock = make_lock("serving.batcher.children")
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name=f"{name}-worker")
         self._worker.start()
@@ -254,8 +257,12 @@ class DynamicBatcher:
             r.future.set_result(out[i])
         self._m_flushes.inc()
         self._m_occupancy.observe(n)
-        children = self._bucket_children.get(bucket)
-        if children is None:            # insert: worker thread only
+        with self._children_lock:
+            children = self._bucket_children.get(bucket)
+        if children is None:
+            # insert: worker thread only.  The label resolution happens
+            # OUTSIDE the children lock so it never nests over the
+            # registry family lock (lock-order hygiene, GL011).
             children = (
                 self._f_bucket_flushes.labels(batcher=self.name,
                                               bucket=bucket),

@@ -18,10 +18,11 @@ poison later hits.
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 
 import numpy as np
+
+from milnce_tpu.analysis.lockrt import make_lock
 
 
 def token_key(row: np.ndarray) -> tuple:
@@ -41,7 +42,7 @@ class EmbeddingLRUCache:
     def __init__(self, capacity: int = 4096):
         self.capacity = int(capacity)
         self._data: OrderedDict[tuple, np.ndarray] = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = make_lock("serving.cache")
         self._hits = 0
         self._misses = 0
 

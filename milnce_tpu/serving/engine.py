@@ -36,7 +36,6 @@ at construction, optionally cast to bf16 for MXU-rate inference.
 
 from __future__ import annotations
 
-import threading
 from typing import Optional, Sequence
 
 import numpy as np
@@ -45,6 +44,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
+from milnce_tpu.analysis.lockrt import make_lock
 from milnce_tpu.obs import spans as obs_spans
 from milnce_tpu.parallel.mesh import batch_sharding, replicated
 from milnce_tpu.serving.batcher import pad_rows
@@ -60,7 +60,10 @@ from milnce_tpu.train.step import make_text_embed_fn, make_video_embed_fn
 # the single per-device execution queue anyway — serialized dispatch is
 # the semantics the hardware gives you, made explicit and deadlock-free.
 # Request-level concurrency belongs ABOVE this lock, in the batcher.
-DEVICE_DISPATCH_LOCK = threading.Lock()
+# Created through make_lock so MILNCE_LOCK_SANITIZE=1 (set before
+# import) swaps in the order-checking SanitizedLock; the "dispatch" in
+# its name is what exempts device work under it from graftlint GL012.
+DEVICE_DISPATCH_LOCK = make_lock("serving.device_dispatch")
 
 
 def bucket_ladder(n_dev: int, min_bucket: int, max_batch: int) -> tuple:
@@ -135,6 +138,12 @@ class InferenceEngine:
         self._batch_sh = batch_sharding(mesh, data_axis)
         self._text_fn = make_text_embed_fn(model, mesh, data_axis)
         self._video_fn = make_video_embed_fn(model, mesh, data_axis)
+        # Bookkeeping shared by the batcher worker, request threads
+        # (video/index paths) and /healthz readers — guarded by its own
+        # tiny lock, NEVER the dispatch lock (stats reads must not
+        # contend with device work).  The unlocked dict update here was
+        # a real lost-increment race (graftlint GL010, ISSUE 7).
+        self._stats_lock = make_lock("serving.engine.stats")
         self._calls: dict[tuple, int] = {}     # (entry, bucket) -> calls
         self._baseline_cache: Optional[dict] = None
         self.embed_dim: Optional[int] = None   # known after the first call
@@ -181,9 +190,11 @@ class InferenceEngine:
         with DEVICE_DISPATCH_LOCK, jax.transfer_guard("disallow"):
             x = jax.device_put(rows, self._batch_sh)
             out = jax.device_get(fn(self._variables, x))
-        self._calls[(entry, bucket)] = self._calls.get((entry, bucket), 0) + 1
         out = np.asarray(out)
-        self.embed_dim = int(out.shape[-1])
+        with self._stats_lock:
+            self._calls[(entry, bucket)] = \
+                self._calls.get((entry, bucket), 0) + 1
+            self.embed_dim = int(out.shape[-1])
         return out[:n]
 
     # ---- warmup + recompile accounting -----------------------------------
@@ -198,7 +209,9 @@ class InferenceEngine:
             for b in self.buckets:
                 self.embed_text(np.zeros((b, self.text_words), np.int32))
                 self.embed_video(np.zeros((b,) + self.video_shape, np.uint8))
-        self._baseline_cache = self._cache_sizes()
+        baseline = self._cache_sizes()
+        with self._stats_lock:
+            self._baseline_cache = baseline
 
     def _cache_sizes(self) -> dict:
         out = {}
@@ -211,20 +224,24 @@ class InferenceEngine:
         """Jit-cache entries created SINCE the warmup sweep — 0 in a
         healthy steady state (pinned by the serve_embed_ladder trace
         invariant).  -1 when this jax build has no cache introspection."""
-        if self._baseline_cache is None:
+        with self._stats_lock:
+            baseline = self._baseline_cache
+        if baseline is None:
             return -1
         now = self._cache_sizes()
-        if -1 in now.values() or -1 in self._baseline_cache.values():
+        if -1 in now.values() or -1 in baseline.values():
             return -1
-        return sum(max(0, now[k] - self._baseline_cache[k]) for k in now)
+        return sum(max(0, now[k] - baseline[k]) for k in now)
 
     def stats(self) -> dict:
+        with self._stats_lock:
+            calls = dict(self._calls)
         return {
             "buckets": list(self.buckets),
             "max_batch": self.max_batch,
             "recompiles": self.recompiles(),
             "calls": {f"{entry}@{bucket}": n
-                      for (entry, bucket), n in sorted(self._calls.items())},
+                      for (entry, bucket), n in sorted(calls.items())},
         }
 
     # ---- construction from a frozen export -------------------------------

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from milnce_tpu.analysis.lockrt import make_lock
 from milnce_tpu.parallel.compat import shard_map
 from milnce_tpu.parallel.mesh import batch_sharding, replicated
 from milnce_tpu.serving.batcher import pad_rows
@@ -104,6 +105,10 @@ class DeviceRetrievalIndex:
             local_topk, mesh=mesh,
             in_specs=(P(data_axis), P(data_axis), P()),
             out_specs=(P(), P()), check_vma=False))
+        # call accounting is hit straight off concurrent request threads
+        # — its own lock, never the dispatch lock (graftlint GL010: the
+        # bare `_calls += 1` here lost increments under contention)
+        self._stats_lock = make_lock("serving.index.stats")
         self._calls = 0
         self._baseline_cache = None
         if precompile:
@@ -134,7 +139,8 @@ class DeviceRetrievalIndex:
             qd = jax.device_put(q, self._query_sh)
             scores, idx = jax.device_get(self._fn(self._corpus, self._valid,
                                                   qd))
-        self._calls += 1
+        with self._stats_lock:
+            self._calls += 1
         return np.asarray(scores)[:n], np.asarray(idx)[:n]
 
     # ---- warmup + observability -----------------------------------------
@@ -143,17 +149,23 @@ class DeviceRetrievalIndex:
         for b in self.query_buckets:
             self.topk(np.zeros((b, self.dim), np.float32))
         size = getattr(self._fn, "_cache_size", None)
-        self._baseline_cache = int(size()) if size is not None else None
+        baseline = int(size()) if size is not None else None
+        with self._stats_lock:
+            self._baseline_cache = baseline
 
     def recompiles(self) -> int:
-        if self._baseline_cache is None:
+        with self._stats_lock:
+            baseline = self._baseline_cache
+        if baseline is None:
             return -1
         size = getattr(self._fn, "_cache_size", None)
         if size is None:
             return -1
-        return max(0, int(size()) - self._baseline_cache)
+        return max(0, int(size()) - baseline)
 
     def stats(self) -> dict:
+        with self._stats_lock:
+            calls = self._calls
         return {"size": self.size, "dim": self.dim, "k": self.k,
                 "query_buckets": list(self.query_buckets),
-                "calls": self._calls, "recompiles": self.recompiles()}
+                "calls": calls, "recompiles": self.recompiles()}

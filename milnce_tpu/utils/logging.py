@@ -1,20 +1,29 @@
 """Run logger: stdout + append-only file under log_root
 (reference: main_distributed.py:304-306, rank-0 gated at call sites).
 
-The file handle is opened ONCE, line-buffered, and flushed per line —
-the original open-per-``log()`` cost a full open/write/close syscall
-round-trip on every display line (and on every decode-failure message
-arriving from reader threads).  ``log_event`` appends structured JSONL
-alongside the text log (``<run>.jsonl``) for machine consumers; the
-richer span/event stream lives in obs/spans.py (RUN_EVENTS.jsonl).
+Both file handles are opened ONCE in ``__init__``, line-buffered, and
+flushed per line — the original open-per-``log()`` cost a full
+open/write/close syscall round-trip on every display line (and on every
+decode-failure message arriving from reader threads), and the later
+lazy open of the JSONL twin happened *inside* the lock: file I/O while
+every logging thread waits, plus a lock-free ``_closed`` double-check
+racing ``close()`` (graftlint GL012/GL010, ISSUE 7).  ``log_event``
+appends structured JSONL alongside the text log (``<run>.jsonl``) for
+machine consumers; the richer span/event stream lives in obs/spans.py
+(RUN_EVENTS.jsonl).
+
+Thread model: ``log``/``log_event`` arrive from reader threads and the
+train loop; ``close`` is terminal (handles are nulled under the lock,
+late calls are no-ops, never a resurrected handle).
 """
 
 from __future__ import annotations
 
 import json
 import os
-import threading
 import time
+
+from milnce_tpu.analysis.lockrt import make_lock
 
 
 class RunLogger:
@@ -24,14 +33,14 @@ class RunLogger:
         self.events_path = None
         self._fh = None
         self._events_fh = None
-        self._closed = False
-        self._lock = threading.Lock()
+        self._lock = make_lock("utils.runlogger")
         if enabled and log_root:
             os.makedirs(log_root, exist_ok=True)
             base = os.path.join(log_root, run_name or "run")
             self.path = base + ".log"
             self.events_path = base + ".jsonl"
             self._fh = open(self.path, "a", buffering=1)
+            self._events_fh = open(self.events_path, "a", buffering=1)
 
     def log(self, message: str) -> None:
         if not self.enabled:
@@ -46,20 +55,17 @@ class RunLogger:
 
     def log_event(self, event: dict) -> None:
         """Append one structured record to the JSONL twin of the text
-        log (opened lazily — most runs never call this).  A no-op after
-        ``close()``, like ``log``: close is terminal, not a flush."""
-        if not self.enabled or not self.events_path or self._closed:
+        log.  A no-op after ``close()``, like ``log``: close is
+        terminal, not a flush (the nulled handle IS the closed flag —
+        one guarded field instead of a racy double-checked pair)."""
+        if not self.enabled:
             return
         with self._lock:
-            if self._closed:
-                return
-            if self._events_fh is None:
-                self._events_fh = open(self.events_path, "a", buffering=1)
-            self._events_fh.write(json.dumps(event) + "\n")
+            if self._events_fh is not None:
+                self._events_fh.write(json.dumps(event) + "\n")
 
     def close(self) -> None:
         with self._lock:
-            self._closed = True
             for fh in (self._fh, self._events_fh):
                 if fh is not None:
                     fh.close()

@@ -2,10 +2,11 @@
 """graftlint CLI: JAX-aware static analysis + trace invariants.
 
 Usage:
-    python scripts/graft_lint.py                  # both passes, write LINT.md
+    python scripts/graft_lint.py                  # all passes, write LINT.md
     python scripts/graft_lint.py --check          # exit 1 on any finding
-    python scripts/graft_lint.py --check --no-trace   # AST pass only (fast,
-                                                      # no jax import)
+    python scripts/graft_lint.py --check --no-trace   # AST passes only
+                                                      # (fast, no jax import)
+    python scripts/graft_lint.py --no-concurrency # skip Pass 3 (GL010-012)
     python scripts/graft_lint.py milnce_tpu/train # explicit scope
 
 Default scope is the ``milnce_tpu`` package — the library code that runs
@@ -35,7 +36,7 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-from milnce_tpu.analysis.astlint import lint_paths  # noqa: E402
+from milnce_tpu.analysis.astlint import lint_paths_full  # noqa: E402
 from milnce_tpu.analysis.report import render_report  # noqa: E402
 
 DEFAULT_SCOPE = ["milnce_tpu"]
@@ -50,13 +51,17 @@ def main(argv=None) -> int:
                          "failed invariant")
     ap.add_argument("--no-trace", action="store_true",
                     help="skip the trace-invariant pass (no jax import)")
+    ap.add_argument("--no-concurrency", action="store_true",
+                    help="skip the concurrency pass (GL010-GL012 + the "
+                         "lock-order graph); still jax-free either way")
     ap.add_argument("--report", default=os.path.join(_REPO, "LINT.md"),
                     help="report path ('' to skip writing)")
     args = ap.parse_args(argv)
 
     os.chdir(_REPO)          # findings print repo-relative paths
     paths = args.paths or DEFAULT_SCOPE
-    findings = lint_paths(paths)
+    findings, lock_graph = lint_paths_full(
+        paths, concurrency=not args.no_concurrency)
     active = [f for f in findings if not f.suppressed]
     for f in active:
         print(f.format())
@@ -78,7 +83,8 @@ def main(argv=None) -> int:
 
     if args.report:
         with open(args.report, "w") as fh:
-            fh.write(render_report(findings, trace_results, paths))
+            fh.write(render_report(findings, trace_results, paths,
+                                   lock_graph))
         print(f"report: {args.report}")
 
     n_bad = len(active) + sum(not r.ok for r in trace_results or [])

@@ -3,7 +3,9 @@
 Every rule is violated exactly once unsuppressed, and once more under an
 inline suppression, so tests/test_graftlint.py can pin EXACT per-rule
 finding counts (a lint whose counts drift is a lint nobody trusts).
-Two malformed suppressions at the bottom pin the GL000 meta-rule.
+Three GL000 cases at the bottom pin the meta-rule: a reasonless
+suppression, an unknown rule, and a STALE suppression (well-formed but
+its rule no longer fires on that line).
 """
 
 import time
@@ -150,7 +152,94 @@ def constrain_ok(x):
     return jax.lax.with_sharding_constraint(x, P("data", "model"))
 
 
+# ---- GL010 unguarded-shared-state --------------------------------------
+
+import threading  # noqa: E402
+
+
+class UnguardedStats:
+    """Thread-shared (owns + acquires a lock): one unguarded shared
+    write, one suppressed guarded-write violation, plus the write-once
+    and annotated exemptions the rule documents."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.calls = 0
+        self.errors = 0
+        self.mode = "ladder"            # write-once: lock-free reads ok
+        self.depth = 2  # guarded-by: _lock
+
+    def record(self):
+        self.calls += 1                 # GL010: unguarded shared write
+        with self._lock:
+            self.errors += 1            # infers the guard: errors -> _lock
+
+    def snapshot(self):
+        with self._lock:
+            errs = self.errors          # ok: read under the guard
+        return {"mode": self.mode,      # ok: write-once lock-free read
+                "depth": self.depth,    # ok: annotated write-once read
+                "errors": errs, "calls": self.calls}
+
+    def reset(self):
+        self.errors = 0  # graftlint: disable=GL010(fixture: the audited suppressed occurrence)
+
+
+# ---- GL011 lock-order-cycle --------------------------------------------
+
+_LOCK_A = threading.Lock()
+_LOCK_B = threading.Lock()
+_LOCK_C = threading.Lock()
+_LOCK_D = threading.Lock()
+
+
+def ordered_ab():
+    with _LOCK_A:
+        with _LOCK_B:                   # establishes A -> B
+            pass
+
+
+def ordered_ba():
+    with _LOCK_B:
+        with _LOCK_A:                   # GL011: closes the A/B cycle
+            pass
+
+
+def ordered_cd():
+    with _LOCK_C:
+        with _LOCK_D:                   # establishes C -> D
+            pass
+
+
+def ordered_dc():
+    with _LOCK_D:
+        # graftlint: disable=GL011(fixture: the audited suppressed occurrence)
+        with _LOCK_C:
+            pass
+
+
+# ---- GL012 blocking-under-lock -----------------------------------------
+
+def wait_under_lock(fut):
+    with _LOCK_A:
+        return fut.result()             # GL012: every A contender stalls
+
+
+def read_under_lock(path):
+    with _LOCK_B:
+        with open(path) as fh:  # graftlint: disable=GL012(fixture: the audited suppressed occurrence)
+            return fh.read()
+
+
+def wait_outside_lock(fut):
+    with _LOCK_A:
+        state = dict(ready=True)        # ok: copy state under the lock,
+    del state                           # block after release
+    return fut.result()
+
+
 # ---- GL000 bad-suppression ---------------------------------------------
 
 x_no_reason = 1  # graftlint: disable=GL001
 x_unknown_rule = 2  # graftlint: disable=GL999(no such rule)
+x_stale = 3  # graftlint: disable=GL001(fixture: stale — GL001 does not fire here)

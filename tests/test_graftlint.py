@@ -32,10 +32,10 @@ def test_fixture_violates_every_rule_exactly_once():
     active = Counter(f.rule.id for f in _fixture_findings()
                      if not f.suppressed)
     assert active == {
-        "GL000": 2,       # missing reason + unknown rule
+        "GL000": 3,       # missing reason + unknown rule + stale
         "GL001": 1, "GL002": 1, "GL003": 1,
         "GL004": 1, "GL005": 1, "GL006": 1, "GL007": 1, "GL008": 1,
-        "GL009": 1,
+        "GL009": 1, "GL010": 1, "GL011": 1, "GL012": 1,
     }, f"per-rule finding counts drifted: {dict(active)}"
 
 
@@ -44,7 +44,8 @@ def test_fixture_suppresses_every_rule_exactly_once():
     counts = Counter(f.rule.id for f in suppressed)
     assert counts == {"GL001": 1, "GL002": 1, "GL003": 1,
                       "GL004": 1, "GL005": 1, "GL006": 1, "GL007": 1,
-                      "GL008": 1, "GL009": 1}, (
+                      "GL008": 1, "GL009": 1, "GL010": 1, "GL011": 1,
+                      "GL012": 1}, (
         f"suppressed counts drifted: {dict(counts)}")
     assert all(f.suppress_reason for f in suppressed), (
         "suppressed findings must carry their audit reason")
@@ -85,7 +86,8 @@ def test_docstrings_mentioning_the_syntax_do_not_parse_as_suppressions():
 
 def test_rule_registry_is_consistent():
     assert set(RULES) == {"GL000", "GL001", "GL002", "GL003", "GL004",
-                          "GL005", "GL006", "GL007", "GL008", "GL009"}
+                          "GL005", "GL006", "GL007", "GL008", "GL009",
+                          "GL010", "GL011", "GL012"}
     assert len(RULES_BY_NAME) == len(RULES), "duplicate rule names"
     for rule in RULES.values():
         assert rule.summary and rule.rationale and rule.fix

@@ -116,6 +116,47 @@ class TestRegistry:
         with pytest.raises(ValueError):
             g.set(3.0)
 
+    def test_gauge_bind_races_value_reads_without_tearing(self):
+        """ISSUE 7 regression: bind() swapped the callback with no lock
+        while scrape threads read (graftlint GL010) — rebinding under
+        concurrent reads must never raise and every read resolves to
+        SOME bound callback's value."""
+        g = MetricsRegistry().gauge("rebind_gauge", "x", fn=lambda: 1.0)
+        errors = []
+        stop = threading.Event()
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    assert g.value in (1.0, 2.0)
+            except Exception as exc:  # pragma: no cover - asserted below
+                errors.append(exc)
+
+        threads = [threading.Thread(target=reader) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for _ in range(500):
+            g.bind(lambda: 2.0)
+            g.bind(lambda: 1.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors
+
+    def test_callback_gauge_callback_runs_outside_the_gauge_lock(self):
+        """The callback is invoked AFTER the gauge lock is released:
+        callbacks read other components' stats (the serving pattern:
+        recompile gauge -> engine stats lock), and calling through
+        while holding this gauge's lock would stack it above every
+        callee lock in the order graph — lock-order hygiene (GL011/
+        GL012 discipline, ISSUE 7)."""
+        reg = MetricsRegistry()
+        g = reg.gauge("hygiene_gauge", "x")
+        held_during_callback = []
+        g.bind(lambda: held_during_callback.append(g._lock.locked()) or 5.0)
+        assert g.value == 5.0
+        assert held_during_callback == [False]
+
     def test_device_array_recording_raises(self):
         """The tentpole invariant: float() of a device array is a
         blocking sync — the registry refuses it at the boundary."""

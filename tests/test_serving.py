@@ -101,6 +101,46 @@ class TestEngine:
                 0, 255, (n, _FRAMES, _SIZE, _SIZE, 3), dtype=np.uint8))
         assert eng.recompiles() == 0
 
+    def test_concurrent_call_accounting_is_exact(self, stack):
+        """ISSUE 7 regression: the engine's per-(entry, bucket) call
+        dict is written from the batcher worker AND request threads
+        while /healthz readers iterate it — the old unlocked
+        read-modify-write lost increments under contention (graftlint
+        GL010).  N threads x K embeds must land EXACTLY N*K counts,
+        with stats() readers racing the whole time."""
+        eng = stack["engine"]
+        key = "text@8"
+        before = eng.stats()["calls"].get(key, 0)
+        n_threads, k = 6, 4
+        ids = np.ones((1, _WORDS), np.int32)
+        stop = threading.Event()
+        errors = []
+
+        def embedder():
+            try:
+                for _ in range(k):
+                    eng.embed_text(ids)
+            except Exception as exc:  # pragma: no cover - the assert
+                errors.append(exc)    # below is the real check
+
+        def reader():
+            while not stop.is_set():
+                s = eng.stats()
+                assert s["calls"].get(key, 0) >= before
+
+        threads = [threading.Thread(target=embedder)
+                   for _ in range(n_threads)]
+        readers = [threading.Thread(target=reader) for _ in range(2)]
+        for t in readers + threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        stop.set()
+        for t in readers:
+            t.join(timeout=30)
+        assert not errors, errors
+        assert eng.stats()["calls"][key] == before + n_threads * k
+
 
 # ---------------------------------------------------------------------------
 # index
@@ -137,6 +177,23 @@ class TestIndex:
         with pytest.raises(ValueError, match="outside"):
             DeviceRetrievalIndex(stack["mesh"], stack["corpus_emb"],
                                  k=_CORPUS + 1, precompile=False)
+
+    def test_concurrent_topk_call_accounting_is_exact(self, stack):
+        """ISSUE 7 regression: `self._calls += 1` straight off request
+        threads lost increments (graftlint GL010) — N threads x K
+        queries must count exactly."""
+        index = stack["index"]
+        before = index.stats()["calls"]
+        n_threads, k = 6, 4
+        q = np.zeros((1, index.dim), np.float32)
+        threads = [threading.Thread(
+            target=lambda: [index.topk(q) for _ in range(k)])
+            for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert index.stats()["calls"] == before + n_threads * k
 
     def test_geometry_follows_data_axis_on_a_model_parallel_mesh(self,
                                                                  stack):
