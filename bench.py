@@ -388,6 +388,28 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
             flops_source = "analytic"
             _note(f"bench: using analytic FLOPs model ({flops:.3e}/step)")
 
+    # static HBM plan of the timed program (graftlint Pass 4,
+    # analysis/memplan.py): per-chip predicted peak bytes ride in the
+    # record so obs_report --check gates memory drift alongside
+    # step-time — a row that got faster by doubling its footprint is a
+    # regression the throughput gate alone would wave through.  Traced
+    # with the TPU donation intent (the production path donates the
+    # state even though this harness builds donate=False for
+    # comparability).  Best-effort: a planner error must cost the
+    # memory field, never the measurement.
+    predicted_peak = None
+    try:
+        from milnce_tpu.analysis.memplan import plan_fn
+        from milnce_tpu.train.step import STATE_DONATION_ARGNUMS
+
+        predicted_peak = plan_fn(
+            step_fn, (state, video_d, text_d, start_d),
+            argnames=("state", "video", "text", "start"),
+            donate_argnums=STATE_DONATION_ARGNUMS).peak_bytes
+    except Exception as exc:
+        _note(f"bench: memplan prediction failed ({type(exc).__name__}: "
+              f"{exc}) — row ships without predicted_peak_bytes_per_chip")
+
     # warmup / compile (NOT `loss` — that name is the loss-selector arg
     # and ends up verbatim in the result record)
     state, warmup_loss = step_fn(state, video_d, text_d, start_d)
@@ -472,6 +494,7 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
         "flops_per_step": flops,
         "flops_source": flops_source if flops else None,
         "flops_per_sec": (flops * inner / dt) if flops else None,
+        "predicted_peak_bytes_per_chip": predicted_peak,
     }
     if peak and result["flops_per_sec"]:
         result["mfu"] = round(result["flops_per_sec"] / (peak * n_chips), 4)
@@ -623,8 +646,10 @@ def _make_record(best, frames, size, on_tpu, kind):
         out["mfu"] = best["mfu"]
     # mesh layout + sharding-map identity (ISSUE 6): obs_report --check
     # can only compare 1-D and 2-D runs if the record says which layout
-    # (and which map) produced the number
-    for key in ("mesh", "sharding_map_hash", "params_sharded"):
+    # (and which map) produced the number.  predicted_peak_bytes_per_chip
+    # (ISSUE 8) makes memory drift gateable the same way.
+    for key in ("mesh", "sharding_map_hash", "params_sharded",
+                "predicted_peak_bytes_per_chip"):
         if best.get(key) is not None:
             out[key] = best[key]
     if not on_tpu:

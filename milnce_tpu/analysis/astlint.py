@@ -69,6 +69,14 @@ _ARRAY_ROOTS = {"np", "numpy", "jnp"}
 _FLOAT_DEFAULT_CTORS = {"zeros", "ones", "empty", "linspace", "eye"}
 _VALUE_CTORS = {"array", "asarray", "full"}
 
+# Entry-level (jaxpr) rules — the Pass 4 planner's GL013-GL015 attach to
+# registered trace entries, never to source lines, so an inline
+# suppression can never match anything: writing one is itself a GL000
+# (the stale-suppression audit, extended to the rules that cannot fire
+# here).  The sanctioned "suppression" is a conscious re-pin of the
+# expectation tables in analysis/memplan.py, same commit.
+_ENTRY_LEVEL_RULES = frozenset({"GL013", "GL014", "GL015"})
+
 _SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=(?P<body>.+)$")
 _ITEM_RE = re.compile(r"\s*(?P<rule>[A-Za-z0-9_-]+)\s*(?:\((?P<reason>.*)\))?\s*$")
 
@@ -143,6 +151,12 @@ def parse_suppressions(src: str, path: str) -> tuple[list[Suppression],
             if rule is None:
                 bad.append(Finding(path, lineno, RULES["GL000"],
                                    f"unknown rule in suppression: {item.strip()!r}"))
+            elif rule.id in _ENTRY_LEVEL_RULES:
+                bad.append(Finding(
+                    path, lineno, RULES["GL000"],
+                    f"suppression of {rule.id} ({rule.name}): entry-level "
+                    "planner rules never fire on source lines — re-pin "
+                    "the expectation in analysis/memplan.py instead"))
             elif not reason:
                 bad.append(Finding(path, lineno, RULES["GL000"],
                                    f"suppression of {rule.id} carries no reason "

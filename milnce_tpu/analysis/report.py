@@ -19,7 +19,7 @@ HEADER = ("<!-- (auto-written by scripts/graft_lint.py — do not hand-edit; "
 
 
 def render_report(findings: list[Finding], trace_results=None,
-                  paths=None, lock_graph=None) -> str:
+                  paths=None, lock_graph=None, mem_results=None) -> str:
     active = [f for f in findings if not f.suppressed]
     suppressed = [f for f in findings if f.suppressed]
     lines = [HEADER, "# graftlint report", ""]
@@ -81,6 +81,25 @@ def render_report(findings: list[Finding], trace_results=None,
         lines.append("| entry | check | status |")
         lines.append("|---|---|---|")
         for r in trace_results:
+            status = "ok" if r.ok else f"**FAIL** — {r.detail}"
+            lines.append(f"| {r.entry} | {r.check} | {status} |")
+    lines.append("")
+
+    lines.append("## Pass 4 — static HBM planner (GL013-GL015)")
+    lines.append("")
+    if mem_results is None:
+        lines.append("(skipped — run without `--no-memplan`/`--no-trace` "
+                     "for the per-entry peak-byte gates; full plan table: "
+                     "MEMPLAN.md via `python scripts/mem_plan.py`)")
+    else:
+        bad = [r for r in mem_results if not r.ok]
+        lines.append(f"- checks: {len(mem_results)}, failing: "
+                     f"**{len(bad)}** (per-entry peak table + "
+                     "contributors: MEMPLAN.md)")
+        lines.append("")
+        lines.append("| entry | check | status |")
+        lines.append("|---|---|---|")
+        for r in mem_results:
             status = "ok" if r.ok else f"**FAIL** — {r.detail}"
             lines.append(f"| {r.entry} | {r.check} | {status} |")
     lines.append("")

@@ -255,6 +255,74 @@ _RULE_LIST = (
             "block-under-lock gets "
             "# graftlint: disable=GL012(<why contenders may wait>)",
     ),
+    Rule(
+        id="GL013",
+        name="peak-budget-regression",
+        summary="per-entry per-chip peak device bytes drifted from the "
+                "pinned budget (static HBM planner)",
+        rationale="Fitting the 32-frame step into HBM was the original "
+                  "run's binding constraint, and our own PERF.md records "
+                  "a >10% batch cliff whose diagnosis cost a chip "
+                  "session.  The Pass 4 planner (analysis/memplan.py) "
+                  "computes each entry's per-chip peak bytes from jaxpr "
+                  "live ranges — sharding- and donation-aware — and pins "
+                  "it like a collective count: a rematerialized "
+                  "activation, a doubled optimizer moment or a lost "
+                  "donation lands as a failing tier-1 check, not as an "
+                  "OOM weeks later on the chip.",
+        example="EXPECTED_PEAK_BYTES['train_step_milnce'] drifts +30%",
+        fix="find the buffer in the GL015 contributor diff / MEMPLAN.md; "
+            "if the growth is intended, re-pin EXPECTED_PEAK_BYTES in "
+            "the same commit (entry-level rule — inline suppressions "
+            "don't apply)",
+    ),
+    Rule(
+        id="GL014",
+        name="ineffective-or-missing-donation",
+        summary="donated buffer that cannot be reused, or a large "
+                "aliasable arg left undonated on a grad-bearing entry",
+        rationale="donate_argnums is the difference between one and two "
+                  "copies of params+opt_state across the update — at "
+                  "real scale, the difference between fitting the batch "
+                  "and OOM (GL003's rationale, enforced at the jaxpr "
+                  "level where it is checkable).  A donation whose "
+                  "buffer matches no program output (or is returned "
+                  "unchanged) is dead weight that reads like a "
+                  "protection; an undonated large aliasable arg is the "
+                  "regression GL003 cannot see once jit sites hide "
+                  "behind factories.  The audit honors the CPU gate "
+                  "(parallel/compat.donation_argnums buys nothing on "
+                  "CPU and double-frees on old jax) while verifying the "
+                  "TPU path still REQUESTS donation.",
+        example="jax.jit(step, donate_argnums=(1,))  # arg 1 is returned "
+                "unchanged",
+        fix="donate the consumed state (train/step.py "
+            "STATE_DONATION_ARGNUMS is the declared intent), or drop a "
+            "donation that cannot take effect; entry-level rule — "
+            "re-register the intent in analysis/memplan.py, inline "
+            "suppressions don't apply",
+    ),
+    Rule(
+        id="GL015",
+        name="top-contributor-drift",
+        summary="an entry's top-3 peak-memory contributors changed "
+                "identity (pinned by name)",
+        rationale="A peak regression inside the GL013 tolerance can "
+                  "still change WHAT occupies the peak — a silently "
+                  "rematerialized activation, an f32 upcast of a bf16 "
+                  "buffer, an optimizer moment that stopped sharding.  "
+                  "Pinning the top-3 contributor NAMES (arg tree paths "
+                  "/ 'primitive aval' labels) turns that into a "
+                  "readable diff instead of a mystery byte delta — the "
+                  "same reasoning as pinning collective multisets "
+                  "rather than just their sum.",
+        example="'conv_general_dilated f32[...]' replaces "
+                "'state/params/conv_2c/...' at the peak",
+        fix="explain the new occupant (MEMPLAN.md names its bytes); if "
+            "intended, re-pin EXPECTED_TOP_CONTRIBUTORS in the same "
+            "commit (entry-level rule — inline suppressions don't "
+            "apply)",
+    ),
 )
 
 RULES: dict[str, Rule] = {r.id: r for r in _RULE_LIST}

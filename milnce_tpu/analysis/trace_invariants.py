@@ -619,9 +619,10 @@ def _entry_serve_embed_ladder() -> list[CheckResult]:
         "warmup bucket sweep — a request shape is escaping the ladder "
         "(weak-type drift, or a pad path missing)")]
     b = engine.buckets[-1]
-    out += _jaxpr_checks("serve_text_embed", engine._text_fn,
+    entries = engine.jit_entries()      # the supported analysis surface
+    out += _jaxpr_checks("serve_text_embed", entries["text"],
                          (varz, np.zeros((b, _WORDS), np.int32)))
-    out += _jaxpr_checks("serve_video_embed", engine._video_fn,
+    out += _jaxpr_checks("serve_video_embed", entries["video"],
                          (varz, np.zeros((b, _FRAMES, _SIZE, _SIZE, 3),
                                          np.uint8)))
     return out
@@ -643,6 +644,7 @@ def _entry_serve_index_topk() -> list[CheckResult]:
     index = DeviceRetrievalIndex(mesh, corpus.astype(np.float32), k=3,
                                  query_buckets=(ndev,))
     name = "serve_index_topk"
+    fn, operands = index.topk_program()  # the supported analysis surface
 
     def make_q(seed):
         # committed to the index's replicated query sharding — an
@@ -651,12 +653,11 @@ def _entry_serve_index_topk() -> list[CheckResult]:
         r = np.random.default_rng(seed)
         return jax.device_put(
             r.standard_normal((ndev, index.dim)).astype(np.float32),
-            index._query_sh)
+            index.query_sharding)
 
-    out = _jaxpr_checks(name, index._fn,
-                        (index._corpus, index._valid, make_q(0)))
+    out = _jaxpr_checks(name, fn, operands + (make_q(0),))
     out.append(_recompile_check(
-        name, index._fn, lambda s: (index._corpus, index._valid, make_q(s))))
+        name, fn, lambda s: operands + (make_q(s),)))
     return out
 
 

@@ -59,6 +59,15 @@ def psum_with_identity_grad(x, axis_name: str):
     return lax.psum(sg(x), axis_name) - sg(x) + x
 
 
+def donation_argnums_for_backend(backend: str, *argnums: int) -> tuple:
+    """The backend-gating rule of :func:`donation_argnums` as a pure
+    function of the backend name — what the graftlint Pass 4 donation
+    audit (analysis/memplan.py GL014) interrogates: the audit runs ON
+    the CPU mesh, where donation is legitimately dropped, but must still
+    verify the TPU path would REQUEST it."""
+    return argnums if backend != "cpu" else ()
+
+
 def donation_argnums(*argnums: int) -> tuple:
     """``donate_argnums`` value, gated by backend.
 
@@ -70,7 +79,7 @@ def donation_argnums(*argnums: int) -> tuple:
     (glibc "corrupted double-linked list"; found by the resume tests the
     moment the shard_map compat made them runnable on jax 0.4.x).  TPU
     and GPU keep full donation."""
-    return argnums if jax.default_backend() != "cpu" else ()
+    return donation_argnums_for_backend(jax.default_backend(), *argnums)
 
 
 def axis_size(axis_name: str):

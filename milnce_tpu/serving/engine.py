@@ -197,6 +197,15 @@ class InferenceEngine:
             self.embed_dim = int(out.shape[-1])
         return out[:n]
 
+    def jit_entries(self) -> dict:
+        """The engine's jitted programs by entry name — the supported
+        surface for the analysis passes (trace invariants pin their
+        collectives; the graftlint Pass 4 planner walks their jaxprs at
+        every ladder rung) instead of reaching into ``_text_fn``/
+        ``_video_fn``.  Tracing these does NOT require a warmed engine:
+        build with ``precompile=False`` for planning-only use."""
+        return {"text": self._text_fn, "video": self._video_fn}
+
     # ---- warmup + recompile accounting -----------------------------------
 
     def warmup(self) -> None:

@@ -143,6 +143,23 @@ class DeviceRetrievalIndex:
             self._calls += 1
         return np.asarray(scores)[:n], np.asarray(idx)[:n]
 
+    def topk_program(self) -> tuple:
+        """``(jitted_fn, (corpus, valid))`` — the compiled retrieval
+        program plus its committed operand arrays, the supported surface
+        for the analysis passes (trace invariants pin its collectives,
+        the Pass 4 planner walks its jaxpr) instead of reaching into
+        ``_fn``/``_corpus``/``_valid``.  Callers append a query batch
+        committed to :attr:`query_sharding`."""
+        return self._fn, (self._corpus, self._valid)
+
+    @property
+    def query_sharding(self):
+        """The replicated sharding query batches must be committed to
+        before calling the program from :meth:`topk_program` directly
+        (an uncommitted host array would key a separate jit-cache
+        entry)."""
+        return self._query_sh
+
     # ---- warmup + observability -----------------------------------------
 
     def warmup(self) -> None:

@@ -52,6 +52,14 @@ from milnce_tpu.parallel.compat import donation_argnums, shard_map
 from milnce_tpu.resilience import faults
 from milnce_tpu.train.state import TrainState
 
+# The train-step donation contract, in ONE place: argument 0 (the
+# TrainState) is consumed and returned, so its buffers are donated on
+# accelerator backends (compat.donation_argnums gates CPU off).  The
+# graftlint Pass 4 donation audit (analysis/memplan.py GL014) reads this
+# as the declared TPU intent — a step factory that stops donating the
+# state, or a new large aliasable argument left undonated, fails there.
+STATE_DONATION_ARGNUMS = (0,)
+
 
 def _apply_grad_poison(grads, step):
     """Device-side ``grad.nonfinite`` fault site: when armed at BUILD
@@ -374,7 +382,8 @@ def make_grad_cache_step(model, optimizer, mesh: Mesh,
         out_specs=(state_spec,) + tail,
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=donation_argnums(0) if donate else ())
+    return jax.jit(sharded, donate_argnums=donation_argnums(
+        *STATE_DONATION_ARGNUMS) if donate else ())
 
 
 def _check_2d_args(mesh: Mesh, data_axis: str, model_axis, state_specs):
@@ -521,7 +530,8 @@ def make_train_step(model, optimizer, mesh: Mesh, data_axis: str = "data",
         out_specs=(state_spec,) + tail,
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=donation_argnums(0) if donate else ())
+    return jax.jit(sharded, donate_argnums=donation_argnums(
+        *STATE_DONATION_ARGNUMS) if donate else ())
 
 
 def make_video_embed_fn(model, mesh: Mesh, data_axis: str = "data",

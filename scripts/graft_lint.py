@@ -7,6 +7,7 @@ Usage:
     python scripts/graft_lint.py --check --no-trace   # AST passes only
                                                       # (fast, no jax import)
     python scripts/graft_lint.py --no-concurrency # skip Pass 3 (GL010-012)
+    python scripts/graft_lint.py --no-memplan     # skip Pass 4 (GL013-015)
     python scripts/graft_lint.py milnce_tpu/train # explicit scope
 
 Default scope is the ``milnce_tpu`` package — the library code that runs
@@ -54,6 +55,10 @@ def main(argv=None) -> int:
     ap.add_argument("--no-concurrency", action="store_true",
                     help="skip the concurrency pass (GL010-GL012 + the "
                          "lock-order graph); still jax-free either way")
+    ap.add_argument("--no-memplan", action="store_true",
+                    help="skip the static HBM planner pass (GL013-GL015 "
+                         "peak/donation/contributor gates; implied by "
+                         "--no-trace)")
     ap.add_argument("--report", default=os.path.join(_REPO, "LINT.md"),
                     help="report path ('' to skip writing)")
     args = ap.parse_args(argv)
@@ -81,18 +86,32 @@ def main(argv=None) -> int:
         for r in trace_results:
             print(r.format())
 
+    mem_results = None
+    if not args.no_trace and not args.no_memplan:
+        # Pass 4 rides on the same hermetic mesh + cached tiny setup the
+        # trace pass just built, so it costs tracing, not model builds
+        from milnce_tpu.analysis.memplan import run_memplan_checks
+
+        mem_results = run_memplan_checks()
+        for r in mem_results:
+            print(r.format())
+
     if args.report:
         with open(args.report, "w") as fh:
             fh.write(render_report(findings, trace_results, paths,
-                                   lock_graph))
+                                   lock_graph, mem_results))
         print(f"report: {args.report}")
 
-    n_bad = len(active) + sum(not r.ok for r in trace_results or [])
+    n_bad = (len(active) + sum(not r.ok for r in trace_results or [])
+             + sum(not r.ok for r in mem_results or []))
     suppressed = sum(f.suppressed for f in findings)
     print(f"graftlint: {len(active)} finding(s), {suppressed} audited "
           f"suppression(s)"
           + ("" if trace_results is None else
              f", {sum(not r.ok for r in trace_results)} invariant "
+             f"failure(s)")
+          + ("" if mem_results is None else
+             f", {sum(not r.ok for r in mem_results)} memplan "
              f"failure(s)"))
     return 1 if (args.check and n_bad) else 0
 

@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""Static HBM planner CLI (graftlint Pass 4 — analysis/memplan.py).
+
+Usage:
+    python scripts/mem_plan.py                   # plan entries, write MEMPLAN.md
+    python scripts/mem_plan.py --check           # exit 1 on GL013/14/15 findings
+    python scripts/mem_plan.py --what-if --batch 256 --mesh data=4,model=2 \
+        --hbm-gib 16                             # operating-point prediction;
+                                                 # exit 1 when it doesn't fit
+
+The default mode walks every registered trace-invariant entry on the
+hermetic CPU mesh and writes the per-entry peak table + top contributors
+to MEMPLAN.md.  ``--check`` is the CI half: the same walk gated against
+the pins in analysis/memplan.py (GL013 peak budget, GL014 donation
+audit, GL015 top-contributor attribution), wired into
+``graft_lint --check`` and the README verify recipe.
+
+``--what-if`` answers "will this config fit?" WITHOUT a chip: the full
+(or tiny) preset model is built at the requested batch/frames/mesh,
+traced abstractly (``jax.eval_shape`` state + ShapeDtypeStruct inputs —
+no device bytes move), and the predicted per-chip peak is compared
+against ``--hbm-gib``.  A config that doesn't fit is REFUSED with a
+nonzero exit naming the top-3 contributors — the 192-batch-cliff /
+curriculum-ladder / FSDP-threshold triage loop, minus the chip time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _parse_mesh(spec: str) -> dict:
+    """'data=4,model=2' -> {'data': 4, 'model': 2} ('' -> {'data': 8},
+    the hermetic default).  Malformed items fail here, not as a silently
+    1-sized axis."""
+    if not spec:
+        return {"data": 8}
+    out: dict = {}
+    for item in spec.split(","):
+        if "=" not in item:
+            raise ValueError(f"mesh item {item!r}: expected axis=N "
+                             "(e.g. data=4,model=2)")
+        ax, n = item.split("=", 1)
+        out[ax.strip()] = int(n)
+    return out
+
+
+def _force_devices(n: int) -> None:
+    """Must run before any jax import: the what-if mesh needs that many
+    virtual CPU devices in the hermetic platform."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+HEADER = ("<!-- (auto-written by scripts/mem_plan.py — do not hand-edit; "
+          "regenerate with `python scripts/mem_plan.py`) -->\n")
+
+
+def _render_memplan(plans: dict, results) -> str:
+    lines = [HEADER, "# MEMPLAN — static per-chip HBM plan", ""]
+    lines.append(
+        "Per-entry peak device bytes from jaxpr live-range analysis "
+        "(graftlint Pass 4, `milnce_tpu/analysis/memplan.py`) on the "
+        "hermetic CPU meshes — sharding-aware (bytes / mesh-axis extent "
+        "per the committed specs) and donation-aware (the TPU path's "
+        "`donate_argnums` applied).  Pinned by `graft_lint --check` "
+        "(GL013/GL015); model + known approximations: ANALYSIS.md "
+        "\"Pass 4\".")
+    lines.append("")
+    lines.append("| entry | mesh | peak/chip | args/chip | outs/chip "
+                 "| top contributors |")
+    lines.append("|---|---|---|---|---|---|")
+    for name, p in plans.items():
+        top = "<br>".join(f"{label} ({b / 2**20:.2f} MiB)"
+                          for label, b in p.contributors[:3])
+        lines.append(
+            f"| {name} | {p.mesh} | {p.peak_bytes / 2**20:.2f} MiB "
+            f"| {p.arg_bytes / 2**20:.2f} MiB "
+            f"| {p.out_bytes / 2**20:.2f} MiB | {top} |")
+    lines.append("")
+    lines.append("## Sharding attribution")
+    lines.append("")
+    lines.append("Donated arg leaves per entry (the GL014 audit surface; "
+                 "donation is gated OFF on CPU by parallel/compat.py but "
+                 "must stay requested for TPU):")
+    lines.append("")
+    for name, p in plans.items():
+        n_don = len(p.donated)
+        lines.append(f"- `{name}`: {n_don} donated leaves"
+                     + (" (none — inference entry)" if not n_don else
+                        f" (state tree; first: `{p.donated[0]}`)"))
+    lines.append("")
+    lines.append("## Pass 4 checks")
+    lines.append("")
+    bad = [r for r in results if not r.ok]
+    lines.append(f"- checks: {len(results)}, failing: **{len(bad)}**")
+    lines.append("")
+    lines.append("| entry | check | status |")
+    lines.append("|---|---|---|")
+    for r in results:
+        status = "ok" if r.ok else f"**FAIL** — {r.detail}"
+        lines.append(f"| {r.entry} | {r.check} | {status} |")
+    lines.append("")
+    lines.append("What-if mode (`python scripts/mem_plan.py --what-if "
+                 "--batch 256 --mesh data=4,model=2 --hbm-gib 16`) "
+                 "predicts TPU operating-point footprints from CPU "
+                 "traces and refuses configs that don't fit — see "
+                 "PERF.md \"Memory planning\".")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on any GL013/GL014/GL015 finding")
+    ap.add_argument("--entries", default="",
+                    help="comma list of entries (default: all registered)")
+    ap.add_argument("--report", default=os.path.join(_REPO, "MEMPLAN.md"),
+                    help="report path ('' to skip writing)")
+    ap.add_argument("--what-if", action="store_true",
+                    help="predict one operating point instead of "
+                         "planning the registered entries")
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--frames", type=int, default=32)
+    ap.add_argument("--size", type=int, default=224)
+    ap.add_argument("--words", type=int, default=20)
+    ap.add_argument("--k", type=int, default=5)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--grad-accum", type=int, default=1)
+    ap.add_argument("--mesh", default="",
+                    help="'data=4,model=2' (what-if; '' = 8-way data)")
+    ap.add_argument("--hbm-gib", type=float, default=16.0,
+                    help="per-chip HBM budget the what-if verdict gates "
+                         "against (v5e 16, v3 32, v5p 95)")
+    ap.add_argument("--preset", default="full", choices=["full", "tiny"],
+                    help="model preset for --what-if (tiny = the test "
+                         "config, seconds to trace)")
+    args = ap.parse_args(argv)
+
+    mesh_axes = _parse_mesh(args.mesh)
+    import math
+
+    _force_devices(math.prod(mesh_axes.values()) if args.what_if else 8)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+    from milnce_tpu.analysis import memplan
+
+    if args.what_if:
+        plan = memplan.what_if_step(
+            batch=args.batch, frames=args.frames, size=args.size,
+            words=args.words, k=args.k, dtype=args.dtype,
+            grad_accum=args.grad_accum, mesh_axes=mesh_axes,
+            preset=args.preset)
+        fits, msg = memplan.budget_verdict(plan, args.hbm_gib)
+        print(msg)
+        return 0 if fits else 1
+
+    entries = ([e for e in args.entries.split(",") if e]
+               or None)
+    plans = memplan.plan_all(entries)
+    results = memplan.run_memplan_checks(entries, plans=plans)
+    for r in results:
+        print(r.format())
+    n_bad = sum(not r.ok for r in results)
+    if n_bad:
+        # BOTH re-pin dicts, ready to paste — a DELIBERATE change (GL013
+        # peak drift or GL015 contributor drift) should cost one copy,
+        # not archaeology
+        print("\n# current values (re-pin consciously if intended):")
+        print("EXPECTED_PEAK_BYTES = {")
+        for name, p in plans.items():
+            print(f'    "{name}": {p.peak_bytes},')
+        print("}")
+        print("EXPECTED_TOP_CONTRIBUTORS = {")
+        for name, p in plans.items():
+            tops = ",\n        ".join(f'"{label}"' for label in p.top())
+            print(f'    "{name}": (\n        {tops}),')
+        print("}")
+    if args.report:
+        with open(args.report, "w") as fh:
+            fh.write(_render_memplan(plans, results))
+        print(f"report: {args.report}")
+    print(f"mem_plan: {len(plans)} entries planned, {n_bad} finding(s)")
+    return 1 if (args.check and n_bad) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

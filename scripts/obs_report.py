@@ -46,6 +46,11 @@ GATE_DIRECTIONS = {
     "latency_ms_p99": "lower",
     "qps": "higher",
     "clips_per_sec_per_chip": "higher",
+    # static HBM plan of the benched program (graftlint Pass 4,
+    # ISSUE 8): a row that got faster by inflating its footprint is a
+    # regression; cross-layout compares stay attributable via the
+    # mesh/sharding_map_hash note
+    "predicted_peak_bytes_per_chip": "lower",
 }
 
 
@@ -135,7 +140,8 @@ def gate_metrics(artifact: dict) -> dict[str, float]:
         v = lat.get(src)
         if isinstance(v, (int, float)):
             out[dst] = float(v)
-    for key in ("qps", "clips_per_sec_per_chip"):
+    for key in ("qps", "clips_per_sec_per_chip",
+                "predicted_peak_bytes_per_chip"):
         v = doc.get(key)
         if isinstance(v, (int, float)):
             out[key] = float(v)

@@ -365,6 +365,37 @@ def main() -> None:
         _write_md(records, args)
 
 
+def _hbm_budget_bytes() -> float | None:
+    """Per-chip device-memory budget for the autotune pre-flight:
+    MILNCE_HBM_GIB (explicit, e.g. 16 for v5e) wins; otherwise the
+    backend's own bytes_limit when it exposes one (TPU does, the CPU
+    test platform doesn't).  None = no budget known, pre-flight off."""
+    env = os.environ.get("MILNCE_HBM_GIB")
+    if env:
+        return float(env) * 2 ** 30
+    import jax
+
+    stats = getattr(jax.local_devices()[0], "memory_stats", lambda: None)()
+    if stats and stats.get("bytes_limit"):
+        return float(stats["bytes_limit"])
+    return None
+
+
+def _preflight_peak(probe_fn, x) -> float | None:
+    """Predicted per-chip peak bytes of one candidate's probe program
+    (graftlint Pass 4 planner) — None when the trace itself fails (the
+    candidate will fail identically when timed; let the sweep surface
+    that error, not the pre-flight)."""
+    try:
+        from milnce_tpu.analysis.memplan import preflight_fn_peak
+
+        return float(preflight_fn_peak(probe_fn, x))
+    except Exception as exc:  # graftlint: disable=GL007(pre-flight is advisory: a planner crash must not kill the sweep the planner exists to protect)
+        print(json.dumps({"preflight_error": f"{type(exc).__name__}: "
+                                             f"{exc}"}), flush=True)
+        return None
+
+
 def autotune(args) -> None:
     """Measure every conv stage under each candidate impl and emit the
     winning per-stage map as a config artifact.
@@ -420,21 +451,48 @@ def autotune(args) -> None:
 
     results = {}                        # stage -> impl -> mode -> ms
     impl_map = {}
+    # pre-flight budget is sweep-invariant; resolving it per candidate
+    # would re-query device memory stats ~impls x stages times
+    budget = _hbm_budget_bytes()
     for idx, (name, _, pool, is_conv) in enumerate(walk):
         if pool is not None:
             x = _tf_same_max_pool(x, *pool)
         if is_conv and (not only or name in only):
             timings = {}
             for impl in impls:
+                # pre-flight what-if (ISSUE 8): a candidate whose
+                # PREDICTED peak exceeds the budget would OOM mid-grid
+                # and cost the sweep its remaining stages — skip it with
+                # the reason on record instead of crashing the probe
+                if budget:
+                    peak = _preflight_peak(
+                        per_impl[impl][modes[-1]][idx][1][1], x)
+                    if peak is not None and peak > budget:
+                        print(json.dumps({
+                            "stage": name, "impl": impl,
+                            "skipped": "predicted peak "
+                            f"{peak / 2**30:.2f} GiB exceeds the "
+                            f"{budget / 2**30:.2f} GiB budget "
+                            "(mem_plan pre-flight)"}), flush=True)
+                        continue
                 timings[impl] = {}
                 for mode in modes:
                     _, probe_fn = per_impl[impl][mode][idx][1]
                     timings[impl][mode] = round(
                         _timed(probe_fn, x, args.iters) * 1e3, 3)
+            if not timings:
+                print(json.dumps({
+                    "stage": name,
+                    "skipped": "every candidate failed the mem_plan "
+                               "pre-flight — stage keeps conv_impl "
+                               "native (no map entry)"}), flush=True)
+                fwd_fn = per_impl[impls[0]][modes[0]][idx][1][0]
+                x = jax.jit(fwd_fn)(x)
+                continue
             # the LAST mode listed picks the winner (fwdbwd by default —
-            # the training cost)
+            # the training cost) among candidates that passed pre-flight
             decide = modes[-1]
-            winner = min(impls, key=lambda i: timings[i][decide])
+            winner = min(timings, key=lambda i: timings[i][decide])
             results[name] = timings
             if winner != "native":      # map only carries overrides
                 impl_map[name] = winner
@@ -498,7 +556,9 @@ def _write_autotune_md(results, impl_map, args, dev_kind) -> None:
         "|---" * (1 + len(impls) * len(modes) + 1) + "|",
     ]
     for stage, timings in results.items():
-        cells = [str(timings[i][m]) for i in impls for m in modes]
+        # a candidate absent from timings failed the mem_plan pre-flight
+        cells = [str(timings.get(i, {}).get(m, "skipped"))
+                 for i in impls for m in modes]
         winner = impl_map.get(stage, "native")
         lines.append(f"| {stage} | " + " | ".join(cells) + f" | {winner} |")
     with open(os.path.join(_REPO, "STAGE_AUTOTUNE.md"), "w") as fh:

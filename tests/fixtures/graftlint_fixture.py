@@ -3,9 +3,11 @@
 Every rule is violated exactly once unsuppressed, and once more under an
 inline suppression, so tests/test_graftlint.py can pin EXACT per-rule
 finding counts (a lint whose counts drift is a lint nobody trusts).
-Three GL000 cases at the bottom pin the meta-rule: a reasonless
-suppression, an unknown rule, and a STALE suppression (well-formed but
-its rule no longer fires on that line).
+Four GL000 cases at the bottom pin the meta-rule: a reasonless
+suppression, an unknown rule, a STALE suppression (well-formed but its
+rule no longer fires on that line), and a suppression of an entry-level
+planner rule (GL013-GL015 attach to registered trace entries, never to
+source lines — the sanctioned route is re-pinning analysis/memplan.py).
 """
 
 import time
@@ -243,3 +245,4 @@ def wait_outside_lock(fut):
 x_no_reason = 1  # graftlint: disable=GL001
 x_unknown_rule = 2  # graftlint: disable=GL999(no such rule)
 x_stale = 3  # graftlint: disable=GL001(fixture: stale — GL001 does not fire here)
+x_entry_level = 4  # graftlint: disable=GL013(planner rules pin entries, not source lines)

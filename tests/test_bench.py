@@ -69,6 +69,16 @@ class TestMakeRecord:
         rec = bench._make_record(best, 16, 224, True, "TPU v5 lite")
         assert "s2d stem" in rec["metric"]
 
+    def test_predicted_peak_rides_into_the_obs_record(self):
+        # ISSUE 8: the static HBM plan is a gate metric — obs_report
+        # flags memory drift only if the record carries it (and a row
+        # whose planner errored ships WITHOUT the field, never with 0)
+        best = dict(self.BEST, predicted_peak_bytes_per_chip=123456789)
+        rec = bench._make_record(best, 16, 224, True, "TPU v5 lite")
+        assert rec["predicted_peak_bytes_per_chip"] == 123456789
+        rec = bench._make_record(self.BEST, 16, 224, True, "TPU v5 lite")
+        assert "predicted_peak_bytes_per_chip" not in rec
+
 
 def test_wedge_truncation_marks_partial(monkeypatch):
     """A config timeout followed by a dead re-probe must stop the sweep
@@ -239,6 +249,9 @@ class TestConfigChild:
         assert r["params_sharded"] > 0
         assert len(r["sharding_map_hash"]) == 12
         assert r["clips_per_sec_per_chip"] > 0
+        # ISSUE 8: every measured row carries its static HBM plan, and
+        # the 2-D row's per-chip prediction reflects the FSDP sharding
+        assert r["predicted_peak_bytes_per_chip"] > 0
         json.dumps(r)
 
     def test_mesh_2d_row_refuses_pure_replication(self, monkeypatch):

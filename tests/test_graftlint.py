@@ -32,7 +32,8 @@ def test_fixture_violates_every_rule_exactly_once():
     active = Counter(f.rule.id for f in _fixture_findings()
                      if not f.suppressed)
     assert active == {
-        "GL000": 3,       # missing reason + unknown rule + stale
+        # missing reason + unknown rule + stale + entry-level (GL013)
+        "GL000": 4,
         "GL001": 1, "GL002": 1, "GL003": 1,
         "GL004": 1, "GL005": 1, "GL006": 1, "GL007": 1, "GL008": 1,
         "GL009": 1, "GL010": 1, "GL011": 1, "GL012": 1,
@@ -87,10 +88,28 @@ def test_docstrings_mentioning_the_syntax_do_not_parse_as_suppressions():
 def test_rule_registry_is_consistent():
     assert set(RULES) == {"GL000", "GL001", "GL002", "GL003", "GL004",
                           "GL005", "GL006", "GL007", "GL008", "GL009",
-                          "GL010", "GL011", "GL012"}
+                          "GL010", "GL011", "GL012", "GL013", "GL014",
+                          "GL015"}
     assert len(RULES_BY_NAME) == len(RULES), "duplicate rule names"
     for rule in RULES.values():
         assert rule.summary and rule.rationale and rule.fix
+
+
+def test_entry_level_rule_suppression_is_gl000():
+    """GL013-GL015 (the Pass 4 planner rules) attach to registered
+    trace entries, never source lines — an inline suppression can't
+    match anything, so writing one is itself a GL000 with the re-pin
+    route named (the stale-suppression audit extended to the rules
+    that cannot fire here)."""
+    for rule_id in ("GL013", "GL014", "GL015"):
+        findings = lint_source(
+            f"y = 1  # graftlint: disable={rule_id}(some reason)\n")
+        assert [f.rule.id for f in findings] == ["GL000"], rule_id
+        assert "memplan" in findings[0].message
+    # by name too
+    (f,) = lint_source("y = 1  # graftlint: disable="
+                       "peak-budget-regression(reason)\n")
+    assert f.rule.id == "GL000" and "memplan" in f.message
 
 
 def test_duplicate_nested_names_are_all_linted():

@@ -398,6 +398,28 @@ class TestObsReport:
         assert code == 1
         assert "[FAIL] qps" in out
 
+    def test_gate_fails_on_memory_footprint_inflation(self, tmp_path):
+        """ISSUE 8: predicted_peak_bytes_per_chip (the static HBM plan
+        bench stamps into each record) gates lower-is-better — a row
+        that got faster by inflating its footprint is a regression; a
+        shrinking footprint never fails (good direction)."""
+        base = tmp_path / "base.json"
+        base.write_text(json.dumps(
+            {**_serve_doc(), "predicted_peak_bytes_per_chip": 10_000_000}))
+        cur = tmp_path / "cur.json"
+        cur.write_text(json.dumps(
+            {**_serve_doc(), "predicted_peak_bytes_per_chip": 12_000_000}))
+        code, out = _run_report("--check", str(cur),
+                                "--baseline", str(base))
+        assert code == 1
+        assert "[FAIL] predicted_peak_bytes_per_chip" in out
+        slim = tmp_path / "slim.json"
+        slim.write_text(json.dumps(
+            {**_serve_doc(), "predicted_peak_bytes_per_chip": 8_000_000}))
+        code, out = _run_report("--check", str(slim),
+                                "--baseline", str(base))
+        assert code == 0, out
+
     def test_gate_all_zero_baseline_never_passes_vacuously(self, tmp_path):
         # an all-zero baseline (e.g. a bench error-path record committed
         # by mistake) skips every shared metric — a gate that compared

@@ -104,6 +104,7 @@ _REPORT_GENERATORS = {
     "XLA_FLAGS_PROBE.md": "scripts/xla_flag_probe.py",
     "DATA_BENCH.md": "scripts/data_bench.py",
     "LINT.md": "scripts/graft_lint.py",
+    "MEMPLAN.md": "scripts/mem_plan.py",
 }
 
 
@@ -137,6 +138,8 @@ def test_report_writers_emit_generator_headers():
         # the CLI that users run
         os.path.join(_REPO, "milnce_tpu", "analysis", "report.py"):
             "auto-written by scripts/graft_lint.py",
+        os.path.join(_REPO, "scripts", "mem_plan.py"):
+            "auto-written by scripts/mem_plan.py",
     }
     for path, header in writers.items():
         assert header in open(path).read(), (
@@ -145,13 +148,14 @@ def test_report_writers_emit_generator_headers():
 
 
 # graftlint gate tests (ISSUE 2; ISSUE 7 added the concurrency pass and
-# the runtime lock sanitizer): the static-analysis + trace-invariant +
-# lock-discipline layer only guards the hot path if it runs on EVERY
-# default `pytest` invocation — a slow-marked (or vanished) gate ships
-# regressions (and re-ships the /healthz-dict class of race).
+# the runtime lock sanitizer; ISSUE 8 the static HBM planner): the
+# static-analysis + trace-invariant + lock-discipline + memory-plan
+# layer only guards the hot path if it runs on EVERY default `pytest`
+# invocation — a slow-marked (or vanished) gate ships regressions (and
+# re-ships the /healthz-dict class of race).
 _ANALYSIS_GATES = ("test_graftlint.py", "test_graftlint_concurrency.py",
                    "test_lockrt.py", "test_trace_invariants.py",
-                   "test_transfer_guard.py")
+                   "test_transfer_guard.py", "test_memplan.py")
 
 
 def test_analysis_gates_exist_and_stay_tier1():
