@@ -58,17 +58,24 @@ BASELINE_THROUGHPUT = 95.35
 # far — what a CPU-fallback record should point readers at
 LAST_TPU_OPERATING_POINT = 392.95
 
-# Peak dense matmul FLOP/s per chip (bf16), by device_kind substring.
-# Public figures; used only for the MFU diagnostic.
-_PEAK_FLOPS = {
-    "v6": 918e12,       # Trillium / v6e
-    "v5p": 459e12,
-    "v5e": 197e12,
-    "v5 lite": 197e12,
-    "v4": 275e12,
-    "v3": 123e12,
-    "v2": 45e12,
-}
+# Peak dense matmul FLOP/s per chip: single-sourced from
+# utils/roofline.py (the train loop's live MFU gauge shares the SAME
+# table + formula, so the two diagnostics can never disagree on what
+# "peak" means).  Imported LAZILY with a fallback: the orchestrator
+# must keep its never-exits-without-a-record contract even on a
+# bring-up host where the package import path is broken — the MFU
+# diagnostic is the only thing lost there.
+_FALLBACK_MAX_PEAK = 918e12     # v6e, the table's ceiling: keeps the
+#                                 measurement plausibility bound armed
+#                                 if the package table is unreachable
+
+
+def _roofline():
+    try:
+        from milnce_tpu.utils import roofline
+    except ImportError:
+        return None
+    return roofline
 
 
 def _emit(result):
@@ -104,11 +111,8 @@ def _note(msg):
 
 
 def _peak_flops(device_kind: str):
-    kind = device_kind.lower()
-    for key, val in _PEAK_FLOPS.items():
-        if key in kind:
-            return val
-    return None
+    rl = _roofline()
+    return rl.device_peak_flops(device_kind) if rl else None
 
 
 def _probe_device_json(run_execute: bool, force_cpu: bool, timeout_s: float):
@@ -472,7 +476,10 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
         # the whole sharded step, so scale the bound by chip count; the
         # fleet-wide max is the fallback when the device kind is unknown.
         implied = guard_flops * inner / dt
-        bound = 1.5 * (peak or max(_PEAK_FLOPS.values())) * n_chips
+        rl = _roofline()
+        fleet_max = (max(rl.PEAK_FLOPS_BY_KIND.values()) if rl
+                     else _FALLBACK_MAX_PEAK)
+        bound = 1.5 * (peak or fleet_max) * n_chips
         if implied > bound:
             raise RuntimeError(
                 f"implausible measurement: {implied:.3e} FLOP/s implied "
@@ -496,8 +503,13 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
         "flops_per_sec": (flops * inner / dt) if flops else None,
         "predicted_peak_bytes_per_chip": predicted_peak,
     }
-    if peak and result["flops_per_sec"]:
-        result["mfu"] = round(result["flops_per_sec"] / (peak * n_chips), 4)
+    if peak and flops:
+        # the SHARED MFU definition (utils/roofline.py) — identical to
+        # the train loop's live gauge given the same throughput
+        from milnce_tpu.utils.roofline import mfu as _shared_mfu
+
+        result["mfu"] = round(_shared_mfu(flops, inner / dt, peak,
+                                          n_chips), 4)
     return result
 
 
@@ -611,6 +623,27 @@ def _is_oom(exc) -> bool:
             or "oom" in text or "exceeds the memory" in text)
 
 
+_BENCH_RUN_ID = None
+
+
+def _bench_run_id():
+    """One id per bench invocation, stamped into every record (interim
+    and final) — the obs run-identity contract (obs/runctx.py), so a
+    directory of bench records aggregates/splits like any other
+    ``milnce.obs/v1`` artifact."""
+    global _BENCH_RUN_ID
+    if _BENCH_RUN_ID is None:
+        try:
+            from milnce_tpu.obs.runctx import auto_run_id
+
+            _BENCH_RUN_ID = auto_run_id("bench-")
+        except ImportError:
+            # broken package path (bring-up host): the record still
+            # ships, with a same-shape locally generated id
+            _BENCH_RUN_ID = f"bench-{int(time.time())}-{os.getpid():04x}"
+    return _BENCH_RUN_ID
+
+
 def _make_record(best, frames, size, on_tpu, kind):
     value = best["clips_per_sec_per_chip"]
     out = {
@@ -621,6 +654,8 @@ def _make_record(best, frames, size, on_tpu, kind):
         # the package import path is broken on a bring-up host.
         "schema": "milnce.obs/v1",
         "kind": "train_bench",
+        "run_id": _bench_run_id(),
+        "process_index": 0,
         "metric": f"train_step clips/sec/chip ({frames}f@{size}, "
                   f"{best['dtype']}, batch {best['batch']}"
                   + (", s2d stem" if best.get("s2d") else "")

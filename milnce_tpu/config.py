@@ -248,6 +248,33 @@ class TrainConfig:
                                         # TraceAnnotation so they land in
                                         # real TPU traces (pairs with
                                         # trace_dir)
+    run_id: str = ""                    # run identity stamped on every
+                                        # RUN_EVENTS.jsonl line + obs
+                                        # snapshot ('' = auto: process 0
+                                        # generates one and broadcasts it
+                                        # cluster-wide).  Pod aggregation
+                                        # and obs_report split on it.
+    anomaly_detect: bool = True         # EWMA step-time spike detector at
+                                        # display cadence (host-side only:
+                                        # fed from the window timing the
+                                        # display already computes); emits
+                                        # 'anomaly' events and arms the
+                                        # profiler capture when configured
+    anomaly_ratio: float = 2.0          # spike = window step time > ratio
+                                        # x EWMA (and > 4 sigma; obs/
+                                        # anomaly.py)
+    anomaly_warmup: int = 3             # display windows before the
+                                        # detector may fire (compile +
+                                        # cache-cold windows)
+    anomaly_cooldown_s: float = 300.0   # suppression window between
+                                        # anomaly events
+    capture_dir: str = ""               # anomaly-triggered bounded one-
+                                        # shot jax.profiler capture root
+                                        # ('' = no capture; also armable
+                                        # via SIGUSR1)
+    capture_ms: float = 2000.0          # capture stops itself after this
+    capture_max: int = 1                # captures per run (a bad run
+                                        # captures once, not forever)
     halt_on_nan: bool = True            # checkpoint + halt when the windowed
                                         # loss goes non-finite (divergence guard)
     max_steps: Optional[int] = None     # stop (with a checkpoint) after N
@@ -330,6 +357,18 @@ class ServeConfig:
                                         # path recorded in the export's
                                         # metadata; without either, only
                                         # token_ids requests work)
+    capture_dir: str = ""               # profiler-capture root for the
+                                        # serving process ('' = POST
+                                        # /obs/capture answers 404);
+                                        # flush-latency anomalies arm it
+                                        # too when set
+    capture_ms: float = 2000.0          # bounded capture duration
+    capture_max: int = 1                # captures per process
+    anomaly_ratio: float = 3.0          # flush-latency spike ratio for
+                                        # the serving EWMA detector
+                                        # (queueing makes latency noisier
+                                        # than step time — wider than the
+                                        # train default)
 
 
 @dataclass

@@ -38,6 +38,16 @@ _OBS_TIMEOUTS = obs_metrics.registry().counter(
 _OBS_RETRIES = obs_metrics.registry().counter(
     "milnce_data_decode_retries_total",
     "fresh decode attempts resubmitted by the watchdog")
+# Data-wait attribution (goodput ledger, OBSERVABILITY.md): seconds the
+# CONSUMER (the train loop pulling device_prefetch) spent blocked on
+# the next batch.  Incremented on the consumer thread itself — create-
+# or-get means the loop reads window deltas off the same child for the
+# live goodput gauge.
+_OBS_DATA_WAIT = obs_metrics.registry().counter(
+    "milnce_data_wait_seconds_total",
+    "host seconds the training loop blocked waiting for batch data")
+
+_EXHAUSTED = object()
 
 
 class ShardedLoader:
@@ -227,11 +237,30 @@ def device_prefetch(iterator: Iterator[dict], mesh: Mesh,
     The batch rows land in device order (process-blocked) rather than
     the loader's strided index assignment; the contrastive losses are
     row-permutation-invariant and video/text/start shard identically, so
-    pairing is preserved."""
+    pairing is preserved.
+
+    Data-wait attribution (the goodput ledger's ``data_wait`` category,
+    OBSERVABILITY.md): every pull of the upstream iterator — the host
+    blocking on decode/stack of the next batch — is timed as a
+    ``data.wait`` span and accumulated on the
+    ``milnce_data_wait_seconds_total`` counter.  Pulls run on the
+    CONSUMER's thread, strictly between its step dispatches, so span
+    time never overlaps the ``step`` spans (the ledger relies on
+    that).  The recorder is resolved per pull, so a run installing its
+    file-backed recorder mid-process diverts these spans with it."""
     place = shard_placer(mesh, axis)
     put = lambda b: jax.tree_util.tree_map(place, b)
     queue = []
-    for batch in iterator:
+    it = iter(iterator)
+    n_pull = 0
+    while True:
+        rec = obs_spans.get_recorder()
+        with rec.span("data.wait", batch=n_pull) as sp:
+            batch = next(it, _EXHAUSTED)
+        _OBS_DATA_WAIT.inc(sp["dur_ms"] / 1e3)
+        if batch is _EXHAUSTED:
+            break
+        n_pull += 1
         queue.append(put(batch))
         if len(queue) > depth:
             yield queue.pop(0)

@@ -12,6 +12,7 @@ from __future__ import annotations
 import numpy as np
 
 from milnce_tpu.config import DataConfig
+from milnce_tpu.resilience import faults
 
 
 class SyntheticVideoTextSource:
@@ -35,6 +36,13 @@ class SyntheticVideoTextSource:
         return black_sample(self.cfg)
 
     def sample(self, idx: int, rng: np.random.RandomState) -> dict:
+        # The same fault chokepoint the real decode path has
+        # (data/video.py sample_clip): ``train.faults`` decode clauses
+        # drive the watchdog/fallback machinery on fully hermetic runs —
+        # the goodput chaos test injects its decode-timeouts here.
+        # Zero-cost disarmed.
+        faults.maybe_raise("decode.raise")
+        faults.maybe_hang("decode.hang")
         c = self.cfg
         base = np.random.RandomState(idx % 1000)
         video = base.randint(0, 255, size=(c.num_frames, c.video_size,

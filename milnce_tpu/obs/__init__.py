@@ -13,6 +13,19 @@ per-tool JSON artifacts with incompatible schemas):
   versioned JSON snapshot schema shared by bench.py, serve_bench.py
   and the train loop.
 
+The attribution tier (ISSUE 9) builds on those streams:
+
+- :mod:`milnce_tpu.obs.runctx` — ``run_id`` + ``process_index``
+  stamped on every record and snapshot;
+- :mod:`milnce_tpu.obs.goodput` — the goodput ledger: run wall time
+  partitioned into compute / data-wait / checkpoint / skipped /
+  rollback-lost badput categories;
+- :mod:`milnce_tpu.obs.anomaly` / :mod:`milnce_tpu.obs.capture` —
+  EWMA spike detection arming a bounded one-shot ``jax.profiler``
+  capture;
+- :mod:`milnce_tpu.obs.aggregate` — pod-level merging (summed
+  counters, min/median/max gauges, straggler skew).
+
 The load-bearing invariant (OBSERVABILITY.md): **recording is host-side
 only and never adds a device sync**.  Nothing in this package imports
 jax at module scope; recording a device value is a :class:`TypeError`,

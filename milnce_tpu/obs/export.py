@@ -22,6 +22,7 @@ from __future__ import annotations
 import json
 import math
 
+from milnce_tpu.obs import runctx
 from milnce_tpu.obs.metrics import MetricsRegistry
 
 SNAPSHOT_SCHEMA = "milnce.obs/v1"
@@ -84,13 +85,20 @@ def to_prometheus(registry: MetricsRegistry) -> str:
 
 
 def snapshot(registry: MetricsRegistry, kind: str = "metrics",
-             extra: dict | None = None) -> dict:
+             extra: dict | None = None, run_id: str | None = None,
+             process_index: int | None = None) -> dict:
     """Versioned JSON document of the registry's current state.
 
     ``kind`` names the producer (``metrics`` for a raw registry dump;
     serve_bench / bench stamp their own).  ``extra`` merges additional
     top-level keys (latency tables, run config) — the ``schema`` /
-    ``kind`` / ``metrics`` keys are reserved."""
+    ``kind`` / ``metrics`` keys are reserved.
+
+    Run identity: the document is stamped with ``run_id`` +
+    ``process_index`` from the installed run context (obs/runctx.py) —
+    every artifact-producing entry point installs one, so pod-level
+    aggregation (obs/aggregate.py) can verify same-run/distinct-process
+    before merging.  Explicit keyword args override the context."""
     metrics: dict = {}
     for fam in registry.collect():
         values = []
@@ -103,6 +111,13 @@ def snapshot(registry: MetricsRegistry, kind: str = "metrics",
         metrics[fam.name] = {"type": fam.type, "help": fam.help,
                              "values": values}
     doc = {"schema": SNAPSHOT_SCHEMA, "kind": kind, "metrics": metrics}
+    ctx_run, ctx_pi = runctx.get_run_context()
+    run_id = run_id if run_id is not None else ctx_run
+    process_index = process_index if process_index is not None else ctx_pi
+    if run_id is not None:
+        doc["run_id"] = str(run_id)
+    if process_index is not None:
+        doc["process_index"] = int(process_index)
     for k, v in (extra or {}).items():
         if k in doc:
             raise ValueError(f"snapshot extra key {k!r} is reserved")
@@ -111,8 +126,11 @@ def snapshot(registry: MetricsRegistry, kind: str = "metrics",
 
 
 def write_snapshot(path: str, registry: MetricsRegistry,
-                   kind: str = "metrics", extra: dict | None = None) -> dict:
-    doc = snapshot(registry, kind, extra)
+                   kind: str = "metrics", extra: dict | None = None,
+                   run_id: str | None = None,
+                   process_index: int | None = None) -> dict:
+    doc = snapshot(registry, kind, extra, run_id=run_id,
+                   process_index=process_index)
     with open(path, "w") as fh:
         json.dump(doc, fh, indent=2, sort_keys=True)
         fh.write("\n")

@@ -37,6 +37,7 @@ from collections import deque
 from typing import Optional
 
 from milnce_tpu.analysis.lockrt import make_lock
+from milnce_tpu.obs import runctx
 
 
 def _now() -> float:
@@ -56,6 +57,7 @@ class SpanRecorder:
         self.profiler_bridge = bool(profiler_bridge)
         self._ring: deque = deque(maxlen=max(1, int(ring)))
         self._lock = make_lock("obs.spans.recorder")
+        self._mono_last = 0.0
         self._fh = None
         if self.path:
             # line-buffered append handle, opened ONCE (the RunLogger
@@ -65,7 +67,28 @@ class SpanRecorder:
     # ---- recording -------------------------------------------------------
 
     def _record(self, rec: dict) -> None:
+        # run identity stamped at RECORD time (not construction): the
+        # owning entry point installs the context once, and every line —
+        # including library events from reader/worker threads — carries
+        # it, so obs_report can split a shared append-only stream by run
+        # and aggregate.py can merge a pod's streams by process
+        run_id, pi = runctx.get_run_context()
+        if run_id is not None and "run_id" not in rec:
+            rec["run_id"] = run_id
+        if pi is not None and "process_index" not in rec:
+            rec["process_index"] = pi
         with self._lock:
+            # append-order monotonic cursor (``GET /obs/events?since=``):
+            # stamped under the lock, and forced STRICTLY increasing —
+            # two back-to-back records rounding to the same microsecond
+            # would otherwise let a poller whose cursor lands between
+            # them miss the second one forever (tail()'s filter is a
+            # strict '>')
+            mono = round(_now(), 6)
+            if mono <= self._mono_last:
+                mono = round(self._mono_last + 1e-6, 6)
+            self._mono_last = mono
+            rec["mono"] = mono
             self._ring.append(rec)
             if self._fh is not None:
                 self._fh.write(json.dumps(rec) + "\n")
@@ -102,12 +125,21 @@ class SpanRecorder:
 
     # ---- reading / lifecycle --------------------------------------------
 
-    def tail(self, n: Optional[int] = None) -> list[dict]:
+    def tail(self, n: Optional[int] = None,
+             since: Optional[float] = None) -> list[dict]:
         """Most recent ``n`` records, oldest first (the whole ring by
         default); ``n <= 0`` is an empty list, not the whole ring (a
-        bare ``out[-0:]`` would invert the limit's meaning)."""
+        bare ``out[-0:]`` would invert the limit's meaning).
+
+        ``since`` keeps only records appended strictly after that
+        ``mono`` cursor (the append-order monotonic stamp every record
+        carries) — pollers pass their last-seen ``mono`` back instead of
+        re-downloading the whole ring (``GET /obs/events?since=``)."""
         with self._lock:
             out = list(self._ring)
+        if since is not None:
+            cut = float(since)
+            out = [r for r in out if r.get("mono", 0.0) > cut]
         if n is None:
             return out
         n = int(n)

@@ -151,6 +151,24 @@ def make_flag_reducer(mesh: Mesh, overlap: bool = False):
     return any_flagged
 
 
+def broadcast_str(value: str, max_len: int = 64) -> str:
+    """Every process returns PROCESS 0's ``value`` (utf-8, truncated to
+    ``max_len`` bytes).  The cluster-uniform run-id primitive: the obs
+    run context must carry ONE id across a pod (aggregation refuses a
+    mixed-run merge), and per-process clocks/pids can't produce that.
+    One-time init cost, before the steady-state transfer guard arms;
+    single-process is a pass-through."""
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    buf = np.zeros((max_len,), np.uint8)
+    raw = value.encode("utf-8")[:max_len]
+    buf[:len(raw)] = np.frombuffer(raw, np.uint8)
+    out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    return bytes(out[out != 0]).decode("utf-8")
+
+
 def replicate_to_mesh(tree, mesh: Mesh):
     """Re-replicate host-local arrays (e.g. an Orbax restore committed to
     one device) over a possibly MULTI-HOST mesh.

@@ -105,9 +105,16 @@ class DynamicBatcher:
                  name: str = "batcher",
                  registry: Optional[obs_metrics.MetricsRegistry] = None,
                  buckets: Optional[tuple] = None,
-                 recorder: Optional[obs_spans.SpanRecorder] = None):
+                 recorder: Optional[obs_spans.SpanRecorder] = None,
+                 on_flush: Optional[Callable[[float, int], None]] = None):
         assert max_batch >= 1
         self._run_batch = run_batch
+        # flush-latency observer ``(dur_ms, live_rows) -> None``: the
+        # service feeds its EWMA spike detector here (anomaly-triggered
+        # profiler capture).  Invoked on the worker thread AFTER the
+        # flush resolves, outside every batcher lock (GL012 discipline:
+        # the callee takes its own locks)
+        self._on_flush = on_flush
         # flush spans go to the injected recorder when the owner (the
         # service) isolates one; None = the process default, resolved at
         # flush time so a later spans.install() is honored
@@ -245,7 +252,7 @@ class DynamicBatcher:
             rec = self._recorder if self._recorder is not None \
                 else obs_spans.get_recorder()
             with rec.span("batcher.flush", batcher=self.name,
-                          bucket=bucket, rows=n):
+                          bucket=bucket, rows=n) as flush_span:
                 out = np.asarray(self._run_batch(rows))
         except Exception as exc:
             # batch failure -> every caller sees the error (never a hang)
@@ -271,6 +278,8 @@ class DynamicBatcher:
                 self._bucket_children[bucket] = children
         children[0].inc()
         children[1].inc(n)
+        if self._on_flush is not None:
+            self._on_flush(flush_span["dur_ms"], n)
 
     @staticmethod
     def _past_ms(r: _Request, now: float) -> float:

@@ -29,7 +29,15 @@ Endpoints (JSON in/out):
                            occupancy histogram, cache hit rate,
                            recompile gauge — OBSERVABILITY.md).
 - ``GET  /obs/events``     the span recorder's in-memory ring as JSON
-                           (``?n=`` limits to the most recent N).
+                           (``?n=`` limits to the most recent N;
+                           ``?since=<mono>`` returns only records
+                           appended after that cursor, so pollers stop
+                           re-downloading the whole ring).
+- ``POST /obs/capture``    arm the bounded one-shot profiler capture
+                           (obs/capture.py; 404 without
+                           ``--serve.capture_dir``, refusal reasons as
+                           JSON — the capture enforces its own
+                           one-in-flight/cooldown/budget discipline).
 
 Deadline semantics: ``timeout_ms`` bounds a request's QUEUE wait in the
 batcher (ROBUSTNESS.md "Serving request path").  An expired request
@@ -50,6 +58,7 @@ import numpy as np
 from milnce_tpu.obs import export as obs_export
 from milnce_tpu.obs import metrics as obs_metrics
 from milnce_tpu.obs import spans as obs_spans
+from milnce_tpu.obs.anomaly import EwmaSpikeDetector
 from milnce_tpu.serving.batcher import DeadlineExpired, DynamicBatcher
 from milnce_tpu.serving.cache import EmbeddingLRUCache, token_key
 
@@ -69,11 +78,22 @@ class RetrievalService:
                  cache: Optional[EmbeddingLRUCache] = None,
                  max_delay_ms: float = 5.0, default_timeout_ms: float = 0.0,
                  registry: Optional[obs_metrics.MetricsRegistry] = None,
-                 recorder: Optional[obs_spans.SpanRecorder] = None):
+                 recorder: Optional[obs_spans.SpanRecorder] = None,
+                 capture=None, anomaly_ratio: float = 3.0):
         self.engine = engine
         self.index = index
         self.tokenizer = tokenizer
         self.cache = cache if cache is not None else EmbeddingLRUCache(0)
+        # Anomaly-triggered profiler capture (obs/anomaly.py + obs/
+        # capture.py): an EWMA detector watches per-flush latency (fed
+        # by the batcher worker) and — when a ProfilerCapture is
+        # injected — arms ONE bounded capture on a spike; POST
+        # /obs/capture arms it manually.  None = events only / 404.
+        self.capture = capture
+        self._flush_detector = EwmaSpikeDetector(
+            "serve.flush_ms", ratio=anomaly_ratio, recorder=recorder,
+            on_anomaly=((lambda v, e: capture.arm(reason="flush_spike"))
+                        if capture is not None else None))
         # Every counter on the request path lives on ONE obs registry
         # (the old per-component dicts raced request threads against the
         # batcher worker; registry metrics are lock-guarded).  None = a
@@ -91,9 +111,11 @@ class RetrievalService:
             engine.embed_text, engine.bucket_for, max_batch=engine.max_batch,
             max_delay_ms=max_delay_ms, default_timeout_ms=default_timeout_ms,
             name="text", registry=self.registry, buckets=engine.buckets,
-            recorder=recorder)
+            recorder=recorder,
+            on_flush=lambda dur_ms, rows: self._flush_detector.observe(
+                dur_ms, rows=rows))
         self._default_timeout_ms = float(default_timeout_ms)
-        self._started = time.time()
+        self._started = time.time()  # graftlint: disable=GL005(wall-clock uptime bookkeeping for /healthz + the uptime gauge — deliberate wall time, not a device-timing delta; audited when main()'s jax import put this file in GL005 scope)
         reg = self.registry
         self._m_queries = reg.counter(
             "milnce_serve_queries_total", "retrieval queries received")
@@ -243,14 +265,28 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply_raw(200, self.service.metrics_text().encode(),
                             obs_export.PROMETHEUS_CONTENT_TYPE)
         elif route == "/obs/events":
-            n = parse_qs(url.query).get("n", [None])[0]
+            qs = parse_qs(url.query)
+            n = qs.get("n", [None])[0]
             try:
                 n = int(n) if n else None
             except ValueError:
                 self._reply(400, {"error": f"n must be an integer, "
                                            f"got {n!r}"})
                 return
-            self._reply(200, {"events": self.service.recorder.tail(n)})
+            # ?since=<mono>: only records appended after that cursor
+            # (the `mono` stamp each record carries) — pollers pass
+            # their last-seen value back instead of re-downloading the
+            # whole ring
+            since = qs.get("since", [None])[0]
+            try:
+                since = float(since) if since else None
+            except ValueError:
+                self._reply(400, {"error": f"since must be a number "
+                                           f"(a record's mono stamp), "
+                                           f"got {since!r}"})
+                return
+            self._reply(200, {"events":
+                              self.service.recorder.tail(n, since=since)})
         else:
             self._reply(404, {"error": f"no route {self.path!r}"})
 
@@ -270,6 +306,17 @@ class _Handler(BaseHTTPRequestHandler):
                 emb = self.service.embed_text_ids(
                     rows, req.get("timeout_ms"))
                 self._reply(200, {"embeddings": emb.tolist()})
+            elif self.path == "/obs/capture":
+                # manual profiler-capture arm; the capture object
+                # enforces the one-shot/cooldown budget and reports a
+                # refusal reason instead of silently double-capturing
+                if self.service.capture is None:
+                    self._reply(404, {"error": "no profiler capture "
+                                               "configured "
+                                               "(--serve.capture_dir)"})
+                else:
+                    self._reply(200, self.service.capture.arm(
+                        reason=str(req.get("reason", "http"))))
             else:
                 self._reply(404, {"error": f"no route {self.path!r}"})
         except DeadlineExpired as exc:
@@ -315,8 +362,12 @@ def main(argv=None) -> None:
     (query requests 400 until an index exists)."""
     import os
 
+    import jax
+
     from milnce_tpu.config import parse_cli
     from milnce_tpu.data.tokenizer import Tokenizer
+    from milnce_tpu.obs import runctx as obs_runctx
+    from milnce_tpu.obs.capture import ProfilerCapture
     from milnce_tpu.parallel.mesh import build_mesh, initialize_distributed
     from milnce_tpu.serving.engine import InferenceEngine
     from milnce_tpu.serving.export import METADATA_FILE
@@ -369,13 +420,23 @@ def main(argv=None) -> None:
         index = DeviceRetrievalIndex(mesh, corpus, k=s.topk,
                                      query_buckets=engine.buckets,
                                      data_axis=cfg.parallel.data_axis)
+    # run identity for every snapshot/event this process emits
+    # (obs/runctx.py — pod aggregation + obs_report split on it)
+    obs_runctx.set_run_context(obs_runctx.auto_run_id("serve-"),
+                               jax.process_index())
+    capture = None
+    if s.capture_dir:
+        capture = ProfilerCapture(s.capture_dir,
+                                  duration_s=s.capture_ms / 1e3,
+                                  max_captures=s.capture_max)
     service = RetrievalService(
         engine, index, tokenizer=tokenizer,
         cache=EmbeddingLRUCache(s.cache_capacity),
         max_delay_ms=s.max_delay_ms, default_timeout_ms=s.default_timeout_ms,
         # the live process has ONE registry: /metrics on this server
         # also exposes anything other subsystems record process-wide
-        registry=obs_metrics.registry())
+        registry=obs_metrics.registry(),
+        capture=capture, anomaly_ratio=s.anomaly_ratio)
     server = serve_http(service, s.host, s.port)
     # flush: operators poll a redirected log for this readiness line
     print(f"milnce-serve: listening on http://{s.host}:"

@@ -12,6 +12,7 @@ time, epoch progress, windowed mean loss, and current LR
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 import time
@@ -27,9 +28,15 @@ from milnce_tpu.data.pipeline import (ShardedLoader, device_prefetch,
                                       flatten_text, shard_placer)
 from milnce_tpu.data.synthetic import SyntheticVideoTextSource
 from milnce_tpu.models.build import build_model
+from milnce_tpu.obs import export as obs_export
+from milnce_tpu.obs import goodput as obs_goodput
 from milnce_tpu.obs import metrics as obs_metrics
+from milnce_tpu.obs import runctx as obs_runctx
 from milnce_tpu.obs import spans as obs_spans
-from milnce_tpu.parallel.mesh import (build_mesh, initialize_distributed,
+from milnce_tpu.obs.anomaly import EwmaSpikeDetector
+from milnce_tpu.obs.capture import ProfilerCapture
+from milnce_tpu.parallel.mesh import (broadcast_str, build_mesh,
+                                      initialize_distributed,
                                       replicate_to_mesh)
 from milnce_tpu.resilience import faults
 from milnce_tpu.train.checkpoint import CheckpointManager
@@ -38,6 +45,10 @@ from milnce_tpu.train.state import TrainState, build_optimizer, create_train_sta
 from milnce_tpu.train.step import make_train_step
 from milnce_tpu.utils.logging import RunLogger
 from milnce_tpu.utils.profiling import StepTimer, maybe_trace
+from milnce_tpu.utils.roofline import (device_peak_flops as roofline_peak,
+                                       mfu as roofline_mfu,
+                                       train_step_flops as
+                                       roofline_step_flops)
 
 
 def build_source(cfg: Config, log_fn=None):
@@ -110,6 +121,40 @@ class TrainResult:
     skipped_steps: int = 0      # finite-guard: updates skipped on
                                 # non-finite gradients (0 when disabled)
     rollbacks: int = 0          # circuit-breaker checkpoint restores
+
+
+def _finalize_goodput_ledger(rec, rec_path, run_id, process_index,
+                             registry, obs_dir, log_fn,
+                             extra: Optional[dict] = None) -> None:
+    """End-of-run goodput ledger (obs/goodput.py): read back this run's
+    event stream (the JSONL file when one exists — the ring is bounded
+    — selecting THIS run out of a shared append-only file by run_id),
+    export the attribution as ``milnce.obs/v1`` gauges, and write the
+    per-run summary snapshot next to the stream.  Best-effort by
+    design: the ledger must never turn a finished (or already-failing)
+    run into an error."""
+    try:
+        if rec_path and os.path.exists(rec_path):
+            with open(rec_path) as fh:
+                records = [json.loads(line) for line in fh if line.strip()]
+        else:
+            records = rec.tail()
+        ledger = obs_goodput.compute_ledger(records, run_id=run_id)
+        obs_goodput.ledger_to_registry(ledger, registry)
+        if rec_path:
+            name = ("GOODPUT.json" if not process_index
+                    else f"GOODPUT.p{process_index}.json")
+            payload = ledger.to_extra()
+            payload.update(extra or {})     # e.g. the live mfu gauge's
+            #                                 last value, gate-able at
+            #                                 top level like clips/s
+            obs_export.write_snapshot(
+                os.path.join(obs_dir, name), registry, kind="goodput",
+                extra=payload)
+        log_fn(ledger.summary_line())
+    except Exception as exc:
+        log_fn(f"goodput ledger failed ({type(exc).__name__}: {exc}) — "
+               "telemetry only, run result unaffected")
 
 
 def _in_training_eval(cfg: Config, model, state: TrainState, mesh,
@@ -190,13 +235,29 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
     # materialized, and the per-step span times host dispatch, never the
     # device (pinned by the train_step_milnce_instrumented trace
     # invariant: identical collectives, survives the transfer guard).
+    #
+    # Run identity: ONE run_id across the whole pod (process 0's value,
+    # broadcast), stamped on every event line and snapshot so streams
+    # sharing an obs_dir split cleanly and pod aggregation can verify
+    # same-run before merging (obs/runctx.py, obs/aggregate.py).
+    process_index = jax.process_index()
+    run_id = cfg.train.run_id or broadcast_str(obs_runctx.auto_run_id())
+    prev_runctx = obs_runctx.set_run_context(run_id, process_index)
     obs_dir = cfg.train.obs_dir or cfg.train.log_root
     rec_path = None
-    if logger.enabled and obs_dir:
+    if cfg.train.verbose and obs_dir:
+        # EVERY process writes its own stream (process 0 keeps the
+        # unsuffixed name) — the per-host streams are what obs_report
+        # --merge turns into the pod view with straggler skew
         os.makedirs(obs_dir, exist_ok=True)
-        rec_path = os.path.join(obs_dir, "RUN_EVENTS.jsonl")
+        name = ("RUN_EVENTS.jsonl" if process_index == 0
+                else f"RUN_EVENTS.p{process_index}.jsonl")
+        rec_path = os.path.join(obs_dir, name)
     rec = obs_spans.SpanRecorder(
         path=rec_path, profiler_bridge=cfg.train.obs_profiler_bridge)
+    rec.event("run.start", seed=cfg.train.seed,
+              batch_size=cfg.train.batch_size,
+              processes=jax.process_count())
     reg = obs_metrics.registry()
     m_steps = reg.counter("milnce_train_steps_total",
                           "optimizer steps dispatched (display-cadence fed)")
@@ -210,6 +271,69 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                           "finite-guard skipped updates (run total)")
     m_rollbacks = reg.counter("milnce_train_rollbacks_total",
                               "circuit-breaker checkpoint restores")
+    g_mfu = reg.gauge("milnce_train_mfu",
+                      "live MFU at the last display (roofline step FLOPs "
+                      "over device peak; only set when both are known)")
+    g_goodput = reg.gauge("milnce_train_goodput_fraction",
+                          "windowed goodput at the last display: elapsed "
+                          "minus data-wait, times the applied-update "
+                          "fraction, over elapsed")
+    # the data-wait accumulator device_prefetch feeds (create-or-get:
+    # same child) — window deltas drive the live goodput gauge
+    m_data_wait = reg.counter(
+        "milnce_data_wait_seconds_total",
+        "host seconds the training loop blocked waiting for batch data")
+
+    # Live MFU denominator/numerator (utils/roofline.py — the SAME
+    # table + formula bench.py uses, pinned within 2% by
+    # tests/test_goodput.py).  FLOPs only for configs the analytic
+    # model covers (bench.py applies the identical guard: DTW losses
+    # and the two-pass grad-accum step would make the number fiction).
+    n_chips = len(jax.devices())
+    dev0 = jax.devices()[0]
+    peak = roofline_peak(str(getattr(dev0, "device_kind", dev0.platform)))
+    step_flops = None
+    if (peak and cfg.loss.name == "milnce" and cfg.train.grad_accum == 1):
+        step_flops = roofline_step_flops(
+            cfg.train.batch_size, cfg.data.num_frames, cfg.data.video_size,
+            cfg.data.num_candidates, cfg.data.max_words,
+            space_to_depth=cfg.model.space_to_depth,
+            inception_blocks=cfg.model.inception_blocks,
+            embedding_dim=cfg.model.embedding_dim,
+            word_dim=cfg.model.word_embedding_dim,
+            hidden=cfg.model.text_hidden_dim)
+
+    # Anomaly-triggered profiler capture (obs/anomaly.py + obs/
+    # capture.py): the EWMA detector watches the window step time the
+    # display already computes (host-side, no new syncs); a spike emits
+    # an 'anomaly' event and — when a capture dir is configured — arms
+    # ONE bounded jax.profiler capture.  SIGUSR1 arms it manually.
+    profiler_capture = None
+    if cfg.train.capture_dir:
+        profiler_capture = ProfilerCapture(
+            cfg.train.capture_dir,
+            duration_s=cfg.train.capture_ms / 1e3,
+            cooldown_s=cfg.train.anomaly_cooldown_s,
+            max_captures=cfg.train.capture_max, recorder=rec)
+    spike_detector = None
+    if cfg.train.anomaly_detect:
+        spike_detector = EwmaSpikeDetector(
+            "train.step_ms", ratio=cfg.train.anomaly_ratio,
+            warmup=cfg.train.anomaly_warmup,
+            cooldown_s=cfg.train.anomaly_cooldown_s, recorder=rec,
+            on_anomaly=((lambda v, e: profiler_capture.arm(
+                reason="step_time_spike"))
+                if profiler_capture is not None else None))
+    capture_requested = {"flag": False}     # SIGUSR1, acted on at display
+    prev_usr1 = None
+    if profiler_capture is not None:
+        def _on_sigusr1(signum, frame):
+            capture_requested["flag"] = True
+
+        try:
+            prev_usr1 = signal.signal(signal.SIGUSR1, _on_sigusr1)
+        except ValueError:       # non-main thread (tests)
+            prev_usr1 = None
 
     source = build_source(cfg, log_fn=logger.log)
     loader = ShardedLoader(source, cfg.train.batch_size, seed=cfg.train.seed,
@@ -388,6 +512,13 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
     # here; afterwards host arithmetic stays exact.
     opt_step0 = int(state.step)
 
+    # Live-goodput window baselines (host counters, reset per display):
+    # data-wait delta off the prefetcher's accumulator, skip delta off
+    # the guard fetch — everything the gauge needs already exists.
+    window_wait0 = m_data_wait.value
+    prev_k_total = 0
+    last_mfu = None
+
     # LR display comes from the numpy twin of the device schedule:
     # float(schedule(step)) of the jnp form was a per-display device
     # round-trip (the original graftlint finding this PR fixes).
@@ -509,38 +640,92 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                   progress = (opt_step % steps_per_epoch) / steps_per_epoch
                   with jax.transfer_guard("allow"):  # display-cadence fetch
                     consec = 0
+                    k_total = 0
                     extra = ""
+                    # the sync span is where the async pipeline's device
+                    # work surfaces on the host — the goodput ledger's
+                    # compute category reads step-dispatch + sync spans
+                    with rec.span("sync", cause="display", step=opt_step):
+                        if guard_on:
+                            (mean_loss, consec,
+                             k_total) = _fetch_guard_window(
+                                running_dev, valid_dev, consec_dev,
+                                skips_total_dev)
+                        else:
+                            mean_loss = fetch(running_dev) / window
                     if guard_on:
-                        mean_loss, consec, k_total = _fetch_guard_window(
-                            running_dev, valid_dev, consec_dev,
-                            skips_total_dev)
                         extra += f", Skipped steps: {k_total}"
-                    else:
-                        mean_loss = fetch(running_dev) / window
                     fails = getattr(source, "decode_failures", 0)
                     extra += f", Decode failures: {fails}"
                     if loader.decode_timeouts:
                         extra += (f", Decode timeouts: "
                                   f"{loader.decode_timeouts}")
+                    # ONE timer read feeds throughput, MFU and the
+                    # detector, so the three can never disagree on the
+                    # window they describe
+                    sps = timer.steps_per_sec
+                    elapsed = timer.elapsed_s
+                    clips_per_sec = sps * cfg.train.batch_size
+                    if step_flops is not None and sps > 0:
+                        last_mfu = roofline_mfu(step_flops, sps, peak,
+                                                n_chips)
+                        g_mfu.set(last_mfu)
+                        extra += f", MFU: {last_mfu:.3f}"
+                    # windowed goodput: elapsed minus host data-wait,
+                    # scaled by the applied-update fraction (a skipped
+                    # step burnt chip time for no kept progress)
+                    wait_now = m_data_wait.value
+                    wait_delta = max(0.0, wait_now - window_wait0)
+                    window_wait0 = wait_now
+                    applied_frac = 1.0
+                    if guard_on and window > 0:
+                        skip_delta = max(0, k_total - prev_k_total)
+                        prev_k_total = k_total
+                        applied_frac = max(0.0, 1.0 - skip_delta / window)
+                    goodput_frac = 0.0
+                    if elapsed > 0:
+                        goodput_frac = (max(0.0, elapsed - wait_delta)
+                                        / elapsed) * applied_frac
+                    g_goodput.set(goodput_frac)
                     logger.log(
                         f"Epoch {epoch + 1}, Elapsed Time: "
                         f"{time.time() - tick:.3f}, Epoch status: "
                         f"{progress:.4f}, Training loss: "
                         f"{mean_loss:.4f}, "
                         f"Learning rate: {lr:.6f}, Throughput: "
-                        f"{timer.clips_per_sec:.1f} clips/s{extra}")
+                        f"{clips_per_sec:.1f} clips/s{extra}")
                     # registry feed: ONLY host values the fetch above
                     # already materialized (the tentpole invariant —
                     # no extra device_get, no per-step recording)
                     m_steps.inc(window)
                     g_loss.set(mean_loss)
                     g_lr.set(lr)
-                    g_tput.set(timer.clips_per_sec)
+                    g_tput.set(clips_per_sec)
                     if guard_on:
                         g_skipped.set(k_total)
                     rec.event("display", step=opt_step, epoch=epoch + 1,
                               loss=float(mean_loss), lr=float(lr),  # graftlint: disable=GL001(json-coercion of the host numpy values the display fetch above already materialized, not device values)
-                              clips_per_sec=timer.clips_per_sec)
+                              clips_per_sec=clips_per_sec,
+                              goodput_fraction=round(goodput_frac, 5),
+                              skipped_total=k_total,
+                              **({"mfu": round(last_mfu, 5)}
+                                 if last_mfu is not None else {}))
+                    # anomaly path (host-side): feed the window's mean
+                    # step wall time; a spike arms the bounded capture.
+                    # The window containing the run's FIRST step is
+                    # excluded — its compile time would set the EWMA
+                    # baseline several times too high and mask every
+                    # real spike for the rest of the run (the ledger
+                    # excludes it from compute for the same reason).
+                    if (spike_detector is not None and window > 0
+                            and (opt_step - window) != opt_step0):
+                        spike_detector.observe(elapsed * 1e3 / window,
+                                               step=opt_step)
+                    if (profiler_capture is not None
+                            and capture_requested["flag"]):
+                        capture_requested["flag"] = False
+                        verdict = profiler_capture.arm(reason="sigusr1")
+                        logger.log(f"SIGUSR1 profiler capture: {verdict}")
                     # a guarded window with ZERO applied updates displays
                     # nan by construction — that is the breaker's case to
                     # handle, not the halt-on-nan divergence guard's
@@ -588,9 +773,17 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                         state = place_state(state)
                         rollbacks += 1
                         m_rollbacks.inc()
+                        # rollback-lost attribution (goodput ledger):
+                        # applied updates since the restored boundary
+                        # save are now discarded — the skipped streak
+                        # is already badput, so it doesn't count twice
+                        lost = max(0, (opt_step
+                                       - int(latest) * steps_per_epoch  # graftlint: disable=GL001(host epoch label from Orbax's step listing, not a device value)
+                                       - consec))
                         rec.event("rollback", step=opt_step,
                                   restored_epoch=int(latest),  # graftlint: disable=GL001(host epoch label from Orbax's step listing, not a device value)
-                                  consecutive_skips=consec)
+                                  consecutive_skips=consec,
+                                  lost_updates=lost)
                         consec_dev = None       # fresh weights: reset streak
                         logger.log(
                             f"circuit breaker: {consec} consecutive "
@@ -644,8 +837,22 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
             faults.disarm()     # a config-armed registry dies with the run
         if prev_handler is not None:
             signal.signal(signal.SIGTERM, prev_handler)
+        if prev_usr1 is not None:
+            signal.signal(signal.SIGUSR1, prev_usr1)
+        if profiler_capture is not None:
+            profiler_capture.close()    # flush a mid-capture trace
+        rec.event("run.end", steps=total_steps)
+        # per-run attribution (obs/goodput.py): partition this run's
+        # wall time, export gauges + the GOODPUT snapshot — best-effort,
+        # AFTER run.end so the ledger's wall covers the whole run
+        _finalize_goodput_ledger(
+            rec, rec_path, run_id, process_index, reg, obs_dir,
+            logger.log,
+            extra=({"mfu": round(last_mfu, 5)}
+                   if last_mfu is not None else None))
         obs_spans.install(prev_rec)     # this run's stream detaches
         rec.close()
+        obs_runctx.set_run_context(*prev_runctx)
         logger.close()
     last, skips = exit_metrics()
     return TrainResult(state, total_steps, last, skips, rollbacks)

@@ -28,6 +28,14 @@ class StepTimer:
         self._steps += 1
 
     @property
+    def steps(self) -> int:
+        return self._steps
+
+    @property
+    def elapsed_s(self) -> float:
+        return time.perf_counter() - self._t0
+
+    @property
     def steps_per_sec(self) -> float:
         dt = time.perf_counter() - self._t0
         return self._steps / dt if dt > 0 else 0.0

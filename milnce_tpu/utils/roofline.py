@@ -23,7 +23,49 @@ total against XLA's compiled cost analysis.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Tuple
+import os
+from typing import List, Optional, Tuple
+
+# Peak dense matmul FLOP/s per chip (bf16), by device_kind substring.
+# Public figures; the MFU diagnostic's denominator — THE table, shared
+# by bench.py and the train loop's live ``milnce_train_mfu`` gauge so
+# the two can never disagree on what "peak" means.
+PEAK_FLOPS_BY_KIND = {
+    "v6": 918e12,       # Trillium / v6e
+    "v5p": 459e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
+
+
+def device_peak_flops(device_kind: str = "") -> Optional[float]:
+    """Peak FLOP/s per chip for a jax ``device_kind`` string, or None
+    when unknown (CPU hosts).  ``MILNCE_PEAK_FLOPS`` overrides — how
+    hermetic CPU tests (and odd fleets) get a deterministic MFU
+    denominator."""
+    env = os.environ.get("MILNCE_PEAK_FLOPS", "")
+    if env:
+        return float(env)
+    kind = device_kind.lower()
+    for key, val in PEAK_FLOPS_BY_KIND.items():
+        if key in kind:
+            return val
+    return None
+
+
+def mfu(flops_per_step: float, steps_per_sec: float,
+        peak_per_chip: float, n_chips: int) -> float:
+    """Model FLOPs utilization: achieved FLOP/s over the fleet's peak.
+    ``flops_per_step`` counts the WHOLE sharded step (the convention of
+    every FLOPs source in this module), so the denominator scales by
+    chip count.  One definition, two consumers — bench.py's offline
+    diagnostic and train/loop.py's live display-cadence gauge — pinned
+    within 2% of each other by tests/test_goodput.py (they agree
+    exactly given the same measured throughput)."""
+    return flops_per_step * steps_per_sec / (peak_per_chip * n_chips)
 
 # (out0a, out1a, out1b, out2a, out2b, out3b) per block — s3dg.py:223-233
 INCEPTION_PLAN = [

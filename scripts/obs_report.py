@@ -14,14 +14,28 @@ Usage::
     python scripts/obs_report.py SERVE_BENCH_tiny_closed.json
     python scripts/obs_report.py --check CURRENT --baseline BASELINE \
         [--tolerance 0.10]                                   # CI gate
+    python scripts/obs_report.py --check CURRENT --baseline latest
+    python scripts/obs_report.py --merge SNAP0 SNAP1 [...] \
+        [--out POD.json]                                     # pod view
 
 The gate compares the artifacts' *gate metrics* (step-time p50/p99 from
 a span stream; latency p50/p99 + QPS from a serve_bench report;
-clips/sec from a train bench record) against a committed baseline and
-exits nonzero when any drifts more than ``--tolerance`` (default 10%)
-in the bad direction — wired next to ``graft_lint.py --check`` in the
-README verify recipe.  Drift in the *good* direction never fails: the
-gate is a regression fence, not a pin.
+clips/sec, MFU + predicted peak bytes from a train bench record;
+``goodput_fraction`` + ``mfu`` from a goodput ledger) against a
+committed baseline and exits nonzero when any drifts more than
+``--tolerance`` (default 10%) in the bad direction — wired next to
+``graft_lint.py --check`` in the README verify recipe.  Drift in the
+*good* direction never fails: the gate is a regression fence, not a
+pin.  ``--baseline latest`` auto-picks the newest same-kind artifact
+in the current artifact's directory.
+
+Run identity (obs/runctx.py): event streams holding records from more
+than one ``run_id`` are a LOUD error (the documented cross-run append
+ambiguity) — pass ``--run-id`` to select one.  ``--merge`` fuses >= 2
+per-process snapshots (or event streams) of ONE run into a pod view:
+counters summed, gauges min/median/max across hosts, straggler
+detection as cross-host step-span skew; the merged snapshot gates with
+``--check`` exactly like a single-process artifact (obs/aggregate.py).
 
 stdlib-only, no jax import: the gate must cost milliseconds in CI.
 """
@@ -36,7 +50,9 @@ import sys
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+from milnce_tpu.obs import aggregate  # noqa: E402  (jax-free)
 from milnce_tpu.obs.export import SNAPSHOT_SCHEMA  # noqa: E402  (jax-free)
+from milnce_tpu.obs.goodput import select_run, split_runs  # noqa: E402
 
 # gate metric name -> direction ("lower" = lower is better)
 GATE_DIRECTIONS = {
@@ -51,6 +67,11 @@ GATE_DIRECTIONS = {
     # regression; cross-layout compares stay attributable via the
     # mesh/sharding_map_hash note
     "predicted_peak_bytes_per_chip": "lower",
+    # attribution tier (ISSUE 9): live MFU + kept-compute fraction are
+    # first-class gate metrics — a run that kept its clips/s by hiding
+    # badput (skips, data waits) fails here
+    "mfu": "higher",
+    "goodput_fraction": "higher",
 }
 
 
@@ -71,11 +92,16 @@ def _percentile(sorted_vals: list, q: float) -> float:
 # loading
 # ---------------------------------------------------------------------------
 
-def load_artifact(path: str) -> dict:
+def load_artifact(path: str, run_id: str | None = None) -> dict:
     """-> ``{"format": "events", "records": [...]}`` for a JSONL stream,
     or ``{"format": "snapshot", "doc": {...}}`` for a schema'd JSON
     document.  Unversioned JSON is an error, not a guess — the whole
-    point of the shared schema is that this tool never sniffs."""
+    point of the shared schema is that this tool never sniffs.
+
+    Event streams are split on ``run_id``: a stream holding more than
+    one run (the append-only cross-run case OBSERVABILITY.md documents)
+    is an error unless ``run_id`` picks one — mixed-run percentiles are
+    confidently wrong, which is worse than failing."""
     with open(path) as fh:
         head = fh.read(1)
         fh.seek(0)
@@ -83,6 +109,7 @@ def load_artifact(path: str) -> dict:
             raise ValueError(f"{path}: empty artifact")
         if path.endswith(".jsonl"):
             records = [json.loads(line) for line in fh if line.strip()]
+            records = select_run(records, run_id)
             return {"format": "events", "records": records, "path": path}
         doc = json.load(fh)
     schema = doc.get("schema")
@@ -141,7 +168,8 @@ def gate_metrics(artifact: dict) -> dict[str, float]:
         if isinstance(v, (int, float)):
             out[dst] = float(v)
     for key in ("qps", "clips_per_sec_per_chip",
-                "predicted_peak_bytes_per_chip"):
+                "predicted_peak_bytes_per_chip", "mfu",
+                "goodput_fraction"):
         v = doc.get(key)
         if isinstance(v, (int, float)):
             out[key] = float(v)
@@ -169,8 +197,29 @@ def render_summary(artifact: dict) -> str:
     else:
         doc = artifact["doc"]
         lines.append(f"  kind: {doc.get('kind')}  schema: {doc['schema']}")
+        if doc.get("run_id") is not None:
+            pi = doc.get("process_index")
+            pod = doc.get("processes")
+            lines.append(
+                f"  run: {doc['run_id']}"
+                + (f"  process: {pi}" if pi is not None else "")
+                + (f"  processes merged: {pod}" if pod is not None else ""))
         for k, v in sorted(gate_metrics(artifact).items()):
             lines.append(f"  {k}: {v}")
+        cats = doc.get("categories_s")
+        if isinstance(cats, dict):      # goodput ledger attribution
+            wall = float(doc.get("wall_s", 0.0)) or None
+            lines.append("  wall-time attribution:")
+            for name, sec in sorted(cats.items(), key=lambda kv: -kv[1]):
+                frac = f" ({sec / wall:.1%})" if wall else ""
+                lines.append(f"    {name:<14} {sec:>10.3f}s{frac}")
+        spread = doc.get("spread")
+        if isinstance(spread, dict):    # pod merge: per-host extremes
+            lines.append("  cross-host spread (min/median/max):")
+            for name in sorted(spread):
+                s = spread[name]
+                lines.append(f"    {name}: {s['min']:g} / "
+                             f"{s['median']:g} / {s['max']:g}")
         metrics = doc.get("metrics") or {}
         if metrics:
             lines.append(f"  registry families: {len(metrics)}")
@@ -244,28 +293,140 @@ def check(current: dict, baseline: dict, tolerance: float) -> tuple[bool,
     return ok, "\n".join(lines)
 
 
+def resolve_latest_baseline(current: dict) -> str:
+    """``--baseline latest``: the newest artifact of the SAME kind in
+    the current artifact's directory (event streams match event
+    streams; snapshots match on their ``kind``).  Kind mismatches are
+    not silently compared — if nothing matches, the error names what
+    WAS found so the refusal is as loud as the incomparable-pair one."""
+    # a merged view has a placeholder path ("<merged:N>"); its "dir"
+    # records the FIRST input artifact's directory so --baseline latest
+    # scans where the snapshots actually live, never the cwd
+    cur_path = os.path.abspath(current["path"])
+    directory = (current.get("dir")
+                 or os.path.dirname(cur_path) or ".")
+    if current["format"] == "events":
+        want_kind = None
+    else:
+        want_kind = current["doc"].get("kind")
+    candidates, rejected = [], []
+    for fname in sorted(os.listdir(directory)):
+        path = os.path.join(directory, fname)
+        if os.path.abspath(path) == cur_path or not os.path.isfile(path):
+            continue
+        if not fname.endswith((".json", ".jsonl")):
+            continue
+        try:
+            art = load_artifact(path)
+        except (OSError, ValueError, json.JSONDecodeError):
+            continue                    # unreadable/mixed: not a baseline
+        got_kind = (art["doc"].get("kind")
+                    if art["format"] == "snapshot" else None)
+        if art["format"] == current["format"] and got_kind == want_kind:
+            candidates.append(path)
+        else:
+            rejected.append(f"{fname} ({got_kind or art['format']})")
+    if not candidates:
+        raise ValueError(
+            f"--baseline latest: no other "
+            f"{want_kind or 'event-stream'} artifact in {directory}"
+            + (f" — kinds present: {', '.join(rejected)}" if rejected
+               else " (directory holds no other artifacts)"))
+    return max(candidates, key=os.path.getmtime)
+
+
+def merge_artifacts(paths: list, run_id: str | None) -> dict:
+    """``--merge``: >= 2 per-process artifacts -> one pod view
+    (obs/aggregate.py).  All-snapshots -> a merged ``pod_<kind>``
+    snapshot artifact; all-event-streams -> a straggler/skew report
+    document.  Mixing the two formats is an error."""
+    arts = [load_artifact(p, run_id) for p in paths]
+    formats = {a["format"] for a in arts}
+    if len(formats) > 1:
+        raise ValueError("--merge needs all-snapshots or all-event-"
+                         "streams, not a mix")
+    src_dir = os.path.dirname(os.path.abspath(paths[0])) or "."
+    if formats == {"snapshot"}:
+        doc = aggregate.merge_snapshots([a["doc"] for a in arts])
+        return {"format": "snapshot", "doc": doc,
+                "path": f"<merged:{len(arts)}>", "dir": src_dir}
+    view = aggregate.merge_event_streams([a["records"] for a in arts])
+    return {"format": "pod_events", "doc": view,
+            "path": f"<merged:{len(arts)}>", "dir": src_dir}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="observability summarizer + regression gate "
                     "(scripts/obs_report.py)")
-    ap.add_argument("artifact",
-                    help="RUN_EVENTS.jsonl or a milnce.obs/v1 JSON doc")
+    ap.add_argument("artifacts", nargs="+",
+                    help="RUN_EVENTS.jsonl or milnce.obs/v1 JSON doc(s); "
+                         ">= 2 with --merge")
     ap.add_argument("--check", action="store_true",
                     help="gate the artifact against --baseline; exit 1 "
                          "on regression")
     ap.add_argument("--baseline", default="",
-                    help="committed baseline artifact to gate against")
+                    help="committed baseline artifact to gate against, "
+                         "or 'latest' to auto-pick the newest same-kind "
+                         "artifact in the current artifact's directory")
     ap.add_argument("--tolerance", type=float, default=0.10,
                     help="allowed bad-direction drift fraction "
                          "(default 0.10)")
+    ap.add_argument("--run-id", default=None,
+                    help="select ONE run out of a shared append-only "
+                         "event stream (mixed-run streams error "
+                         "otherwise)")
+    ap.add_argument("--merge", action="store_true",
+                    help="merge >= 2 per-process artifacts of one run "
+                         "into a pod view (counters summed, gauges "
+                         "min/median/max, straggler skew)")
+    ap.add_argument("--out", default="",
+                    help="with --merge: write the merged pod snapshot "
+                         "here (gate it later with --check)")
     args = ap.parse_args(argv)
 
     try:
-        current = load_artifact(args.artifact)
+        if args.merge:
+            current = merge_artifacts(args.artifacts, args.run_id)
+        else:
+            if len(args.artifacts) != 1:
+                print("obs_report: multiple artifacts need --merge",
+                      file=sys.stderr)
+                return 2
+            current = load_artifact(args.artifacts[0], args.run_id)
     except (OSError, ValueError, json.JSONDecodeError) as exc:
-        print(f"obs_report: cannot read {args.artifact}: {exc}",
+        print(f"obs_report: cannot read {' '.join(args.artifacts)}: {exc}",
               file=sys.stderr)
         return 2
+
+    if current["format"] == "pod_events":
+        # straggler report: per-process step stats + cross-host skew
+        view = current["doc"]
+        print(f"pod event merge: run {view['run_id']}, "
+              f"{view['processes']} processes")
+        for pi in sorted(view["per_process"]):
+            s = view["per_process"][pi]
+            lines = (f"  p{pi}: {s['steps']} steps, step p50 "
+                     f"{s['step_ms_p50']} ms, p99 {s['step_ms_p99']} ms")
+            if pi in view["stragglers"]:
+                lines += "   <-- STRAGGLER"
+            print(lines)
+        print(f"  step p50 skew (slowest/fastest): "
+              f"{view['step_p50_skew']}x "
+              f"(straggler threshold {view['straggler_ratio']}x)")
+        if args.out:
+            with open(args.out, "w") as fh:
+                json.dump(view, fh, indent=2, sort_keys=True)
+                fh.write("\n")
+        # a skewed pod is a finding, not a gate failure — gating step
+        # time happens against a baseline via --check on the streams
+        return 0
+
+    if args.merge and args.out:
+        with open(args.out, "w") as fh:
+            json.dump(current["doc"], fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        current["path"] = args.out
 
     if not args.check:
         print(render_summary(current))
@@ -275,10 +436,15 @@ def main(argv=None) -> int:
         print("obs_report: --check requires --baseline", file=sys.stderr)
         return 2
     try:
-        baseline = load_artifact(args.baseline)
+        baseline_path = (resolve_latest_baseline(current)
+                         if args.baseline == "latest" else args.baseline)
+        # the baseline is a DIFFERENT run by definition — it must be a
+        # clean single-run artifact on its own, so --run-id (which
+        # selects out of the CURRENT stream) does not apply to it
+        baseline = load_artifact(baseline_path)
     except (OSError, ValueError, json.JSONDecodeError) as exc:
-        print(f"obs_report: cannot read baseline {args.baseline}: {exc}",
-              file=sys.stderr)
+        print(f"obs_report: cannot resolve baseline {args.baseline}: "
+              f"{exc}", file=sys.stderr)
         return 2
     ok, report = check(current, baseline, args.tolerance)
     print(report)

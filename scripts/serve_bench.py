@@ -297,11 +297,16 @@ def main(argv=None) -> int:
     # the versioned obs snapshot (OBSERVABILITY.md): registry metrics
     # (request counters, per-bucket occupancy, collect-time gauges) plus
     # the report keys above as extras — SERVE_BENCH_*.json and train
-    # bench records are now diffable by one tool (scripts/obs_report.py)
+    # bench records are now diffable by one tool (scripts/obs_report.py).
+    # run_id/process_index tag the report like every other artifact
+    # (obs/runctx.py).
     from milnce_tpu.obs import export as obs_export
+    from milnce_tpu.obs.runctx import auto_run_id
 
     report = obs_export.snapshot(service.registry, kind="serve_bench",
-                                 extra=extra)
+                                 extra=extra,
+                                 run_id=auto_run_id("sbench-"),
+                                 process_index=0)
     out = args.out or os.path.join(
         _REPO, f"SERVE_BENCH_{args.preset}_{args.mode}.json")
     with open(out, "w") as fh:

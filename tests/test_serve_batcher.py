@@ -242,3 +242,29 @@ def test_injected_recorder_receives_flush_spans():
     b.close()
     spans = [r for r in rec.tail() if r.get("name") == "batcher.flush"]
     assert len(spans) == 1 and spans[0]["rows"] == 1
+
+
+def test_on_flush_observer_sees_duration_and_rows():
+    """ISSUE 9: the flush-latency observer (the service's EWMA spike
+    detector feed) fires once per successful flush with (dur_ms, rows)
+    — and never for a failed batch."""
+    seen = []
+    eng = _FakeEngine(delay_s=0.02)
+    b = _mk(eng, max_delay_ms=10,
+            on_flush=lambda dur_ms, rows: seen.append((dur_ms, rows)))
+    futs = [b.submit(r) for r in _rows(3)]
+    for f in futs:
+        f.result(timeout=5)
+    b.close()
+    assert len(seen) == 1
+    dur_ms, rows = seen[0]
+    assert rows == 3 and dur_ms >= 20.0 - 1.0   # the engine's delay
+
+    seen.clear()
+    bad = _mk(_FakeEngine(fail=True), max_delay_ms=10,
+              on_flush=lambda dur_ms, rows: seen.append((dur_ms, rows)))
+    fut = bad.submit(np.ones((3,), np.float32))
+    with pytest.raises(ValueError, match="injected"):
+        fut.result(timeout=5)
+    bad.close()
+    assert seen == []

@@ -448,3 +448,80 @@ class TestHTTP:
             urllib.request.urlopen(f"{http_server}/obs/events?n=abc",
                                    timeout=30)
         assert exc.value.code == 400
+
+    def test_obs_events_since_filters_incrementally(self, stack,
+                                                    http_server):
+        """ISSUE 9 satellite: ?since=<mono> returns only records
+        appended after that cursor, so pollers stop re-downloading the
+        whole ring."""
+        words = stack["service"].engine.text_words
+        stack["service"].query_ids(
+            np.zeros((1, words), np.int32))
+        with urllib.request.urlopen(f"{http_server}/obs/events",
+                                    timeout=30) as r:
+            events = json.loads(r.read())["events"]
+        assert events and all("mono" in e for e in events)
+        cursor = events[-1]["mono"]
+        with urllib.request.urlopen(
+                f"{http_server}/obs/events?since={cursor}",
+                timeout=30) as r:
+            assert json.loads(r.read())["events"] == []
+        # new traffic -> only the new records come back.  The row must
+        # be a row no test has embedded before: a repeat is a CACHE HIT
+        # answered on host — no flush, no new events (that's the cache
+        # working, not the filter failing)
+        fresh = (np.arange(words, dtype=np.int32)[None, :] % 50) + 11
+        stack["service"].query_ids(fresh)
+        with urllib.request.urlopen(
+                f"{http_server}/obs/events?since={cursor}",
+                timeout=30) as r:
+            newer = json.loads(r.read())["events"]
+        assert newer and all(e["mono"] > cursor for e in newer)
+
+    def test_obs_events_bad_since_is_400(self, http_server):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"{http_server}/obs/events?since=yesterday", timeout=30)
+        assert exc.value.code == 400
+
+    def test_obs_capture_404_without_capture(self, http_server):
+        # this module's service is built without a ProfilerCapture
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(f"{http_server}/obs/capture", {})
+        assert exc.value.code == 404
+
+    def test_obs_capture_arms_injected_capture(self, stack, tmp_path):
+        """POST /obs/capture arms the bounded one-shot capture; the
+        budget's refusal reason comes back as JSON (ISSUE 9)."""
+        from milnce_tpu.obs.capture import ProfilerCapture
+        from milnce_tpu.serving.service import serve_http
+
+        calls = {"start": 0, "stop": 0}
+        cap = ProfilerCapture(
+            str(tmp_path), duration_s=1000.0, max_captures=1,
+            start_fn=lambda d: calls.__setitem__("start",
+                                                calls["start"] + 1),
+            stop_fn=lambda: calls.__setitem__("stop", calls["stop"] + 1))
+        service = stack["service"]
+        old_cap = service.capture
+        service.capture = cap
+        server = serve_http(service, port=0)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            status, body = _post(f"{base}/obs/capture",
+                                 {"reason": "drill"})
+            assert status == 200 and body["armed"]
+            assert "capture_001-drill" in body["trace_dir"]
+            assert calls["start"] == 1
+            # active -> refused with a reason, not double-started
+            status, body = _post(f"{base}/obs/capture", {})
+            assert status == 200 and not body["armed"]
+            assert "reason" in body
+            cap.stop()
+            assert calls["stop"] == 1
+        finally:
+            service.capture = old_cap
+            server.shutdown()
+            server.server_close()

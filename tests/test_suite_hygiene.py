@@ -217,12 +217,15 @@ def test_serving_gates_exist_and_stay_tier1():
             f"are the request-path regression fence): {fname}::{slow}")
 
 
-# observability gates (ISSUE 5): the obs subsystem's tests — registry
-# thread-safety with exact counts, the Prometheus exposition golden, the
-# obs_report regression gate, and the instrumented-train-run event
-# stream — are the telemetry regression fence.  Same rule as the
-# analysis/chaos/serving gates: tier-1, never @slow, never vanished.
-_OBS_GATES = ("test_obs.py",)
+# observability gates (ISSUE 5; ISSUE 9 added the attribution tier —
+# goodput ledger, live MFU, anomaly->capture, pod aggregation): the obs
+# subsystem's tests — registry thread-safety with exact counts, the
+# Prometheus exposition golden, the obs_report regression gate, the
+# instrumented-train-run event stream, and the ledger/capture
+# acceptance runs — are the telemetry regression fence.  Same rule as
+# the analysis/chaos/serving gates: tier-1, never @slow, never
+# vanished.
+_OBS_GATES = ("test_obs.py", "test_goodput.py")
 
 
 def test_obs_gates_exist_and_stay_tier1():
