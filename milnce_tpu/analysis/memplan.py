@@ -562,6 +562,50 @@ def _e_serve(entry: str, bucket_idx: int):
     return build
 
 
+@functools.lru_cache(maxsize=1)
+def _serve_pool_engine():
+    """Cold SINGLE-DEVICE replica engine — the pool's CPU test shape
+    (serving/pool.py: one replica per device group, single-device groups
+    on the CPU backend).  The per-chip plan of a replica entry must
+    charge exactly ONE replica's footprint: params are per-replica
+    copies but each lives on its own device group, so N replicas never
+    stack bytes on a chip (a divisor-of-1 shard_map on the replica's own
+    mesh, NOT the full test mesh's 8-way division)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from milnce_tpu.analysis.lockrt import make_lock
+    from milnce_tpu.analysis.trace_invariants import (_FRAMES, _SIZE,
+                                                      _WORDS, _setup)
+    from milnce_tpu.serving.engine import InferenceEngine
+
+    model, _opt, _mesh, state, _batch = _setup()
+    varz = {"params": state.params, "batch_stats": state.batch_stats}
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+    engine = InferenceEngine(
+        model, varz, mesh, text_words=_WORDS,
+        video_shape=(_FRAMES, _SIZE, _SIZE, 3), max_batch=4, min_bucket=2,
+        precompile=False,
+        dispatch_lock=make_lock("serving.replica0.dispatch"))
+    return engine, varz
+
+
+def _e_pool_serve(entry: str, bucket_idx: int):
+    def build():
+        import numpy as np
+
+        from milnce_tpu.analysis.trace_invariants import _FRAMES, _SIZE, _WORDS
+
+        engine, varz = _serve_pool_engine()
+        fn = engine.jit_entries()[entry]
+        b = engine.buckets[bucket_idx]
+        x = (np.zeros((b, _WORDS), np.int32) if entry == "text"
+             else np.zeros((b, _FRAMES, _SIZE, _SIZE, 3), np.uint8))
+        return fn, (varz, x)
+    return build
+
+
 def _e_index_topk():
     def build():
         import jax
@@ -611,6 +655,10 @@ def _entries() -> dict:
                  argnames=("variables", "video")),
         MemEntry("serve_index_topk", _e_index_topk(),
                  argnames=("corpus", "valid", "queries")),
+        MemEntry("serve_pool_text_embed@b0", _e_pool_serve("text", 0),
+                 argnames=("variables", "tokens"), mesh="1x1 replica"),
+        MemEntry("serve_pool_video_embed@b1", _e_pool_serve("video", 1),
+                 argnames=("variables", "video"), mesh="1x1 replica"),
     )}
 
 
@@ -631,6 +679,14 @@ EXPECTED_PEAK_BYTES = {
     "serve_video_embed@b0": 2311104,
     "serve_video_embed@b1": 2503616,
     "serve_index_topk": 2436,
+    # replica-pool entries (ISSUE 10): per-chip bytes on a replica's OWN
+    # single-device mesh.  The pin is the no-double-count property: a
+    # pool puts ONE replica per device (group), so a replica's per-chip
+    # footprint equals the single-engine entry at the same rows-per-chip
+    # (text@b0 here is 2 rows on 1 chip == serve_text_embed@b1's 16 rows
+    # over 8 chips — byte-identical), never N-replicas-times-anything
+    "serve_pool_text_embed@b0": 2119592,
+    "serve_pool_video_embed@b1": 2888640,
 }
 
 # Pinned top-3 peak contributors per entry (GL015), by aggregated label:
@@ -683,6 +739,14 @@ EXPECTED_TOP_CONTRIBUTORS = {
         "queries",
         "all_gather float32[8,24]",
         "all_gather int32[8,24]"),
+    "serve_pool_text_embed@b0": (
+        "variables/params/conv_2c/conv_spatial/kernel",
+        "variables/params/conv_2c/conv_temporal/kernel",
+        "variables/params/mixed_3b/conv_b1_b/conv_spatial/kernel"),
+    "serve_pool_video_embed@b1": (
+        "variables/params/conv_2c/conv_spatial/kernel",
+        "variables/params/conv_2c/conv_temporal/kernel",
+        "variables/params/mixed_3b/conv_b1_b/conv_spatial/kernel"),
 }
 
 

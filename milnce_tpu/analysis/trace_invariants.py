@@ -108,6 +108,11 @@ EXPECTED_COLLECTIVES = {
     "serve_text_embed": {},
     "serve_video_embed": {},
     "serve_index_topk": {"all_gather": 2},
+    # replica pool (ISSUE 10): each replica's engine runs on its OWN
+    # mesh (single-device on the CPU backend) — its embed programs must
+    # stay collective-free like the single-engine entries
+    "serve_pool_text_embed": {},
+    "serve_pool_video_embed": {},
 }
 
 
@@ -628,6 +633,63 @@ def _entry_serve_embed_ladder() -> list[CheckResult]:
     return out
 
 
+def _entry_serve_pool_embed() -> list[CheckResult]:
+    """Pooled serving (ISSUE 10 acceptance): a 2-replica pool — single-
+    device engines on the CPU backend, each with its own dispatch lock —
+    sweeps the FULL bucket ladder (every rung plus pad-path sizes), both
+    per-replica and routed through the pool, and must create ZERO
+    jit-cache entries after warmup on EVERY replica.  Also pins each
+    replica's embed jaxprs collective-free (a one-device shard_map ships
+    nothing)."""
+    import numpy as np
+
+    from milnce_tpu.serving.pool import ReplicaPool
+
+    model, _opt, _mesh, state, _batch = _setup()
+    varz = {"params": state.params, "batch_stats": state.batch_stats}
+    pool = ReplicaPool.build(model, varz, 2, text_words=_WORDS,
+                             video_shape=(_FRAMES, _SIZE, _SIZE, 3),
+                             max_batch=4, min_bucket=2,
+                             probe_interval_s=60.0)
+    try:
+        rng = np.random.default_rng(0)
+        sizes = list(pool.buckets) + [1, pool.buckets[0] + 1]  # pad paths
+
+        def t_rows(n):
+            return rng.integers(0, _TINY["vocab_size"],
+                                (n, _WORDS)).astype(np.int32)
+
+        def v_rows(n):
+            return rng.integers(0, 255, (n, _FRAMES, _SIZE, _SIZE, 3),
+                                dtype=np.uint8)
+
+        for r in pool.replicas:           # every replica, every rung
+            for n in sizes:
+                r.engine.embed_text(t_rows(n))
+                r.engine.embed_video(v_rows(n))
+        for n in sizes:                   # and routed through the pool
+            pool.embed_text(t_rows(n))
+            pool.embed_video(v_rows(n))
+        out = []
+        for r in pool.replicas:
+            n_re = r.engine.recompiles()
+            out.append(CheckResult(
+                "serve_pool_embed", f"recompile-replica{r.rid}", n_re == 0,
+                "" if n_re == 0 else f"{n_re} jit-cache entries appeared "
+                f"AFTER the warmup sweep on replica {r.rid} — a request "
+                "shape is escaping the replica's ladder"))
+        b = pool.buckets[-1]
+        entries = pool.replicas[0].engine.jit_entries()
+        out += _jaxpr_checks("serve_pool_text_embed", entries["text"],
+                             (varz, np.zeros((b, _WORDS), np.int32)))
+        out += _jaxpr_checks("serve_pool_video_embed", entries["video"],
+                             (varz, np.zeros((b, _FRAMES, _SIZE, _SIZE, 3),
+                                             np.uint8)))
+        return out
+    finally:
+        pool.close()
+
+
 def _entry_serve_index_topk() -> list[CheckResult]:
     """Sharded retrieval: exactly 2 all_gathers (the (Q, k) score and
     index candidate lists), no f64, and the double-call recompile check
@@ -675,6 +737,7 @@ ENTRY_POINTS = {
     "param_treedef": _entry_param_treedef,
     "serve_embed_ladder": _entry_serve_embed_ladder,
     "serve_index_topk": _entry_serve_index_topk,
+    "serve_pool_embed": _entry_serve_pool_embed,
 }
 
 

@@ -335,7 +335,14 @@ class ServeConfig:
     device efficiency (taller ladder = fuller MXU at high load),
     ``max_delay_ms`` bounds how long a lone request waits for batch
     company, ``default_timeout_ms`` bounds total queue wait before a
-    request errors (DeadlineExpired) instead of silently aging."""
+    request errors (DeadlineExpired) instead of silently aging.
+
+    Resilience tier (serving/pool.py, ROBUSTNESS.md "Serving request
+    path"): ``replicas`` > 1 serves through a ReplicaPool — per-replica
+    dispatch locks, bounded queues, health-gated routing, quarantine +
+    probe recovery, hedged dispatch — and ``max_inflight`` arms the
+    admission controller's bounded global queue + deadline-feasibility
+    load shedding (HTTP 429)."""
 
     max_batch: int = 64                 # top of the bucket ladder
     min_bucket: int = 0                 # smallest bucket (0 = mesh size)
@@ -369,6 +376,38 @@ class ServeConfig:
                                         # (queueing makes latency noisier
                                         # than step time — wider than the
                                         # train default)
+    replicas: int = 1                   # engine replica pool size (1 = the
+                                        # single-engine path; >1 = one
+                                        # engine per device group, single-
+                                        # device groups on the CPU backend
+                                        # — serving/pool.py)
+    replica_queue_depth: int = 16       # bounded per-replica work queue;
+                                        # all queues full = HTTP 429
+    error_threshold: int = 3            # consecutive dispatch errors
+                                        # before a replica QUARANTINES
+                                        # (ReplicaDead quarantines at once)
+    slo_ms: float = 0.0                 # per-dispatch latency SLO driving
+                                        # the DEGRADED breaker (0 = off)
+    slo_breaches: int = 5               # consecutive SLO breaches before
+                                        # SERVING -> DEGRADED (and the
+                                        # in-SLO streak to recover)
+    probe_interval_s: float = 1.0       # quarantined replicas re-probed
+                                        # (synthetic embed at the smallest
+                                        # bucket) at this cadence
+    hedge_quantile: float = 0.0         # hedge a dispatch still pending
+                                        # past this latency quantile to a
+                                        # second healthy replica (first
+                                        # result wins; 0 = off)
+    hedge_min_ms: float = 20.0          # hedge threshold floor — never
+                                        # hedge sooner than this
+    max_requeues: int = 1               # dispatch errors retried on
+                                        # another replica before the
+                                        # caller sees the failure
+    max_inflight: int = 0               # admission controller: bounded
+                                        # global in-flight rows; past it
+                                        # requests shed with HTTP 429 +
+                                        # Retry-After (0 = unbounded).
+                                        # /healthz and /metrics never shed.
 
 
 @dataclass

@@ -23,6 +23,23 @@ Sites (the catalogue ROBUSTNESS.md documents):
                        schedule is baked into the jitted step, so firing
                        costs no host sync.
 
+Serving sites (threaded through ``InferenceEngine._run`` — each fires
+on whichever engine replica performs the scheduled dispatch, so chaos
+tests can kill/hang/flake individual pool replicas deterministically;
+serving/pool.py, ROBUSTNESS.md "Serving request path"):
+
+- ``serve.dispatch_raise`` host; the embed dispatch raises
+                       :class:`InjectedFault` (exercises the pool's
+                       requeue + consecutive-error quarantine breaker).
+- ``serve.dispatch_hang`` host; the dispatch sleeps ``x`` seconds
+                       (exercises the latency-SLO breaker and hedged
+                       dispatch; default x=5).
+- ``serve.replica_dead`` host; the engine serving the scheduled
+                       dispatch is PERMANENTLY killed (every later call
+                       raises ``ReplicaDead`` — simulates a lost device/
+                       process; the pool quarantines it and probes keep
+                       failing).
+
 Spec grammar (config ``train.faults`` or env ``MILNCE_FAULTS``)::
 
     spec   := clause (';' clause)*
@@ -52,7 +69,8 @@ from milnce_tpu.analysis.lockrt import make_lock
 from milnce_tpu.obs import metrics as obs_metrics
 
 KNOWN_SITES = ("decode.raise", "decode.hang", "ckpt.save_ioerror",
-               "grad.nonfinite")
+               "grad.nonfinite", "serve.dispatch_raise",
+               "serve.dispatch_hang", "serve.replica_dead")
 
 # Process-wide injection telemetry (OBSERVABILITY.md): chaos drills and
 # failure-rate dashboards read how often each site actually fired.
@@ -203,6 +221,17 @@ def maybe_hang(site: str, default_sleep: float = 5.0) -> None:
     s = reg.fire(site)
     if s is not None:
         time.sleep(s.x or default_sleep)
+
+
+def fire_site(site: str) -> bool:
+    """Count one occurrence of ``site``; True when this occurrence is
+    scheduled to fail — for call sites whose failure response is not an
+    exception or a sleep (e.g. ``serve.replica_dead`` flips the engine's
+    dead flag)."""
+    reg = _active()
+    if reg is None:
+        return False
+    return reg.fire(site) is not None
 
 
 def device_schedule(site: str) -> SiteSpec | None:

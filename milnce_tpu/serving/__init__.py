@@ -5,8 +5,11 @@ turns them into a production inference path the ROADMAP's north star
 demands: a bucketed, pre-traced, transfer-guarded embedding engine
 (`engine`), a dynamic micro-batcher with per-request deadlines
 (`batcher`), an LRU text-embedding cache (`cache`), a device-resident
-sharded retrieval index (`index`), a stdlib HTTP/JSON front
-(`service`), and the params-only export that feeds it (`export`).
+sharded retrieval index (`index`), an engine replica pool with
+health-gated routing, hedged dispatch and quarantine/probe recovery
+(`pool`), a stdlib HTTP/JSON front with admission control and a
+degradation ladder (`service`), and the params-only export that feeds
+it (`export`).
 
 Import discipline: `batcher` and `cache` are numpy-only (usable, and
 testable, without jax); `engine`/`index` own every device interaction

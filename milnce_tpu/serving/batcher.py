@@ -57,7 +57,16 @@ _IDLE_POLL_S = 0.05
 
 
 class DeadlineExpired(RuntimeError):
-    """The request's deadline passed while it was still queued."""
+    """The request's deadline passed while it was still queued.
+
+    ``retry_after_ms`` is the server's retry hint (a fresh, lone request's
+    expected queue wait) — the HTTP front surfaces it as a real
+    ``Retry-After`` header plus a ``retry_after_ms`` JSON body field
+    (SERVING.md "HTTP error contract")."""
+
+    def __init__(self, msg: str, retry_after_ms: float = 0.0):
+        super().__init__(msg)
+        self.retry_after_ms = float(retry_after_ms)
 
 
 def pad_rows(rows: np.ndarray, bucket: int) -> np.ndarray:
@@ -97,6 +106,12 @@ class DynamicBatcher:
       sees the request path).
     - ``buckets``: the engine's ladder, used as the occupancy histogram's
       fixed edges (None = powers of two up to ``max_batch``).
+    - ``run_batch_async``: optional Future-returning batch executor (e.g.
+      ``ReplicaPool.submit_text``).  When set, the worker SUBMITS each
+      padded batch and moves on — results scatter to the callers' futures
+      from a completion callback — so several batches can be in flight
+      across pool replicas at once and one wedged replica never blocks
+      the flush loop.  ``run_batch`` is ignored when this is set.
     """
 
     def __init__(self, run_batch: Callable[[np.ndarray], np.ndarray],
@@ -106,9 +121,12 @@ class DynamicBatcher:
                  registry: Optional[obs_metrics.MetricsRegistry] = None,
                  buckets: Optional[tuple] = None,
                  recorder: Optional[obs_spans.SpanRecorder] = None,
-                 on_flush: Optional[Callable[[float, int], None]] = None):
+                 on_flush: Optional[Callable[[float, int], None]] = None,
+                 run_batch_async: Optional[Callable[[np.ndarray],
+                                                    Future]] = None):
         assert max_batch >= 1
         self._run_batch = run_batch
+        self._run_batch_async = run_batch_async
         # flush-latency observer ``(dur_ms, live_rows) -> None``: the
         # service feeds its EWMA spike detector here (anomaly-triggered
         # profiler capture).  Invoked on the worker thread AFTER the
@@ -233,7 +251,8 @@ class DynamicBatcher:
             if r.deadline is not None and r.deadline < now:
                 r.future.set_exception(DeadlineExpired(
                     f"deadline exceeded by {self._past_ms(r, now):.1f} ms "
-                    "while queued (request was never batched)"))
+                    "while queued (request was never batched)",
+                    retry_after_ms=self.max_delay_s * 1e3))
                 expired += 1
             else:
                 live.append(r)
@@ -249,6 +268,16 @@ class DynamicBatcher:
             # dead worker would strand every later submit forever
             bucket = self._bucket_for(n)
             rows = pad_rows(np.stack([r.payload for r in live]), bucket)
+            if self._run_batch_async is not None:
+                # pipelined mode: submit and move on — the pool resolves
+                # the batch on its own worker and the completion callback
+                # scatters results, so the NEXT batch can flush (to
+                # another replica) while this one is still in flight
+                t0 = time.monotonic()
+                fut = self._run_batch_async(rows)
+                fut.add_done_callback(
+                    lambda f: self._complete(f, live, bucket, n, t0))
+                return
             rec = self._recorder if self._recorder is not None \
                 else obs_spans.get_recorder()
             with rec.span("batcher.flush", batcher=self.name,
@@ -262,14 +291,42 @@ class DynamicBatcher:
             return
         for i, r in enumerate(live):
             r.future.set_result(out[i])
+        self._account_flush(bucket, n, flush_span["dur_ms"])
+
+    def _complete(self, f: Future, live: list[_Request], bucket: int,
+                  n: int, t0: float) -> None:
+        """Async-flush completion (runs on the pool's worker thread):
+        scatter per-row results / the batch error, then the same
+        accounting as a synchronous flush.  The timed record is an
+        ``event`` with ``dur_ms`` (a span cannot straddle threads)."""
+        try:
+            out = np.asarray(f.result())
+        except Exception as exc:
+            for r in live:
+                r.future.set_exception(exc)
+            self._m_batch_errors.inc()
+            return
+        for i, r in enumerate(live):
+            r.future.set_result(out[i])
+        dur_ms = round((time.monotonic() - t0) * 1e3, 4)
+        rec = self._recorder if self._recorder is not None \
+            else obs_spans.get_recorder()
+        rec.event("batcher.flush", batcher=self.name, bucket=bucket,
+                  rows=n, dur_ms=dur_ms)
+        self._account_flush(bucket, n, dur_ms)
+
+    def _account_flush(self, bucket: int, n: int, dur_ms: float) -> None:
         self._m_flushes.inc()
         self._m_occupancy.observe(n)
         with self._children_lock:
             children = self._bucket_children.get(bucket)
         if children is None:
-            # insert: worker thread only.  The label resolution happens
+            # insert: flush path only (worker thread, or the pool worker
+            # resolving an async flush).  The label resolution happens
             # OUTSIDE the children lock so it never nests over the
-            # registry family lock (lock-order hygiene, GL011).
+            # registry family lock (lock-order hygiene, GL011); a racing
+            # double-insert writes the same label children twice, which
+            # is idempotent.
             children = (
                 self._f_bucket_flushes.labels(batcher=self.name,
                                               bucket=bucket),
@@ -279,7 +336,7 @@ class DynamicBatcher:
         children[0].inc()
         children[1].inc(n)
         if self._on_flush is not None:
-            self._on_flush(flush_span["dur_ms"], n)
+            self._on_flush(dur_ms, n)
 
     @staticmethod
     def _past_ms(r: _Request, now: float) -> float:
@@ -306,6 +363,11 @@ class DynamicBatcher:
     def close(self, timeout: float = 5.0) -> None:
         self._closed.set()
         self._worker.join(timeout)
+
+    def depth(self) -> int:
+        """Requests currently queued (approximate — stdlib qsize).  The
+        admission controller's feasibility input (service.py)."""
+        return self._q.qsize()
 
     def stats(self) -> dict:
         """Counters + the batch-occupancy histogram (bucket -> how full

@@ -47,8 +47,16 @@ from jax.sharding import Mesh
 from milnce_tpu.analysis.lockrt import make_lock
 from milnce_tpu.obs import spans as obs_spans
 from milnce_tpu.parallel.mesh import batch_sharding, replicated
+from milnce_tpu.resilience import faults
 from milnce_tpu.serving.batcher import pad_rows
 from milnce_tpu.train.step import make_text_embed_fn, make_video_embed_fn
+
+
+class ReplicaDead(RuntimeError):
+    """The engine has been force-killed (``serve.replica_dead`` fault or
+    :meth:`InferenceEngine.kill`) — every dispatch fails instantly until
+    the process restarts.  The replica pool treats this as a permanent
+    condition: the replica quarantines and its probes keep failing."""
 
 # One device-dispatch queue per process, shared by every serving
 # component that executes on the mesh (engine entries AND index.topk).
@@ -115,14 +123,22 @@ class InferenceEngine:
     - ``cast_dtype``: optional float dtype ('bfloat16') the frozen params
       are cast to at load — the model itself must be built with the
       matching compute dtype (``InferenceEngine.from_export`` wires both).
+    - ``dispatch_lock``: the lock serializing this engine's device work.
+      Default is the process-wide :data:`DEVICE_DISPATCH_LOCK`; the
+      replica pool (serving/pool.py) passes each replica its OWN lock so
+      one wedged replica cannot stall the others' dispatch queues (the
+      lock's name must contain "dispatch" — the GL012 exemption).
     """
 
     def __init__(self, model, variables, mesh: Mesh, *, text_words: int,
                  video_shape: Sequence[int], max_batch: int = 64,
                  min_bucket: int = 0, data_axis: str = "data",
-                 cast_dtype: Optional[str] = None, precompile: bool = True):
+                 cast_dtype: Optional[str] = None, precompile: bool = True,
+                 dispatch_lock=None):
         self.mesh = mesh
         self.data_axis = data_axis
+        self._dispatch_lock = (dispatch_lock if dispatch_lock is not None
+                               else DEVICE_DISPATCH_LOCK)
         # batch divisibility is governed by the DATA axis extent alone:
         # on a (data, model) mesh the embed programs shard rows over
         # data and replicate over model (P(data) in/out specs)
@@ -147,6 +163,7 @@ class InferenceEngine:
         self._calls: dict[tuple, int] = {}     # (entry, bucket) -> calls
         self._baseline_cache: Optional[dict] = None
         self.embed_dim: Optional[int] = None   # known after the first call
+        self._dead = False                     # guarded-by: _stats_lock
         if precompile:
             self.warmup()
 
@@ -185,9 +202,23 @@ class InferenceEngine:
         n = rows.shape[0]
         bucket = self.bucket_for(n)
         rows = pad_rows(rows, bucket)
+        # Serving-path fault sites (resilience/faults.py; chaos tests
+        # kill/hang/flake individual replicas through here).  Checked
+        # BEFORE the dispatch lock: a dead replica fails instantly and a
+        # hang wedges only this engine's callers, never the lock queue
+        # of a pool sibling.
+        if self.dead:
+            raise ReplicaDead("replica is dead (serve.replica_dead / "
+                              "kill()) — restart the process to revive it")
+        faults.maybe_raise("serve.dispatch_raise")
+        faults.maybe_hang("serve.dispatch_hang")
+        if faults.fire_site("serve.replica_dead"):
+            self.kill()
+            raise ReplicaDead("injected fault at serve.replica_dead — "
+                              "this replica is now permanently dead")
         # Steady state: implicit transfers are bugs (they stall the async
         # dispatch pipeline); both legs of the request are explicit.
-        with DEVICE_DISPATCH_LOCK, jax.transfer_guard("disallow"):
+        with self._dispatch_lock, jax.transfer_guard("disallow"):
             x = jax.device_put(rows, self._batch_sh)
             out = jax.device_get(fn(self._variables, x))
         out = np.asarray(out)
@@ -242,13 +273,31 @@ class InferenceEngine:
             return -1
         return sum(max(0, now[k] - baseline[k]) for k in now)
 
+    # ---- liveness (pool failure isolation) -------------------------------
+
+    @property
+    def dead(self) -> bool:
+        with self._stats_lock:
+            return self._dead
+
+    def kill(self) -> None:
+        """Force-kill this engine: every subsequent dispatch raises
+        :class:`ReplicaDead` instantly.  The ``serve.replica_dead`` fault
+        site and chaos drills use this to simulate a replica whose
+        device/process is gone; there is no un-kill — recovery is a
+        process restart (the pool keeps it QUARANTINED forever)."""
+        with self._stats_lock:
+            self._dead = True
+
     def stats(self) -> dict:
         with self._stats_lock:
             calls = dict(self._calls)
+            dead = self._dead
         return {
             "buckets": list(self.buckets),
             "max_batch": self.max_batch,
             "recompiles": self.recompiles(),
+            "dead": dead,
             "calls": {f"{entry}@{bucket}": n
                       for (entry, bucket), n in sorted(calls.items())},
         }

@@ -43,24 +43,38 @@ Deadline semantics: ``timeout_ms`` bounds a request's QUEUE wait in the
 batcher (ROBUSTNESS.md "Serving request path").  An expired request
 fails with HTTP 504 / :class:`~milnce_tpu.serving.batcher.DeadlineExpired`
 — never a silent drop.
+
+HTTP error contract (SERVING.md "HTTP error contract"): every refusal
+is a STRUCTURED JSON body — ``{"error", "kind", "reason"?,
+"retry_after_ms"?}`` — and 429/503/504 responses carry a real
+``Retry-After`` header.  504 = this request aged out (DeadlineExpired);
+429 = shed at admission (bounded global queue full, deadline provably
+infeasible, or every replica queue full — try again later); 503 =
+degraded service (no healthy replica; cache hits still answered, misses
+refused).  ``/healthz`` and ``/metrics`` NEVER shed — an overloaded
+service must stay observable.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
+import math
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import numpy as np
 
+from milnce_tpu.analysis.lockrt import make_lock
 from milnce_tpu.obs import export as obs_export
 from milnce_tpu.obs import metrics as obs_metrics
 from milnce_tpu.obs import spans as obs_spans
 from milnce_tpu.obs.anomaly import EwmaSpikeDetector
 from milnce_tpu.serving.batcher import DeadlineExpired, DynamicBatcher
 from milnce_tpu.serving.cache import EmbeddingLRUCache, token_key
+from milnce_tpu.serving.pool import PoolSaturated, PoolUnavailable
 
 log = logging.getLogger(__name__)
 
@@ -71,6 +85,145 @@ log = logging.getLogger(__name__)
 _RESULT_WAIT_SLACK_S = 30.0
 
 
+class ShedError(RuntimeError):
+    """Request refused at ADMISSION (HTTP 429): the bounded global
+    queue is full or the deadline is provably infeasible.  Nothing was
+    queued — retrying after ``retry_after_ms`` is safe and cheap."""
+
+    def __init__(self, msg: str, reason: str, retry_after_ms: float):
+        super().__init__(msg)
+        self.reason = reason
+        self.retry_after_ms = float(retry_after_ms)
+
+
+class DegradedError(RuntimeError):
+    """Request refused because the service is DEGRADED (HTTP 503): no
+    healthy replica can embed.  ``reason`` is machine-readable —
+    ``cache_only`` (hits still answered, this request missed) or
+    ``no_healthy_replicas`` (cache disabled/cold: full 503)."""
+
+    def __init__(self, msg: str, reason: str, retry_after_ms: float = 1000.0):
+        super().__init__(msg)
+        self.reason = reason
+        self.retry_after_ms = float(retry_after_ms)
+
+
+class AdmissionController:
+    """Bounded global queue + deadline-feasibility load shedding.
+
+    Sits in FRONT of the batcher (`embed_text_ids` / `query_ids` admit
+    through here; `/healthz` and `/metrics` never do).  Two refusal
+    conditions, both HTTP 429 with ``Retry-After``:
+
+    - **overload**: admitted-but-unresolved rows would exceed
+      ``max_inflight`` (the bounded global queue; 0 disables);
+    - **deadline infeasibility**: the request carries a deadline, and a
+      PROVABLE lower bound on its queue wait already exceeds it.  The
+      bound is conservative: (batches provably ahead in the queue,
+      spread across the pool's dispatch lanes) x the FASTEST dispatch
+      ever observed — when it sheds, the request could not have met its
+      deadline even on the service's best day, so failing it now (with
+      nothing queued) beats failing it later with a 504 after it
+      consumed queue space.
+
+    Both refusals require the controller to be ARMED
+    (``max_inflight`` > 0 — the config.py contract), and feasibility
+    additionally needs latency samples; until the first dispatch
+    completes it never sheds on deadline (the bound is unknown, so the
+    controller stays conservative in the other direction).  The floor
+    must be fed PURE dispatch time: the single-engine service feeds
+    batcher flush durations (flush == dispatch there), the pooled
+    service feeds the pool's per-dispatch latencies — an async flush's
+    submit-to-resolution time includes replica queue wait and would
+    inflate the "provable" floor into false 429s."""
+
+    def __init__(self, max_inflight: int, *, max_batch: int, lanes: int = 1,
+                 depth_fn=None,
+                 registry: Optional[obs_metrics.MetricsRegistry] = None):
+        self.max_inflight = int(max_inflight)
+        self.max_batch = max(1, int(max_batch))
+        self.lanes = max(1, int(lanes))
+        self._depth_fn = depth_fn           # batcher queue depth (rows)
+        self._lock = make_lock("serving.admission")
+        self._inflight = 0                  # guarded-by: _lock
+        self._flush_floor_ms: Optional[float] = None  # guarded-by: _lock
+        self._flush_mean_ms: Optional[float] = None   # guarded-by: _lock
+        reg = registry if registry is not None \
+            else obs_metrics.MetricsRegistry()
+        self._f_shed = reg.counter(
+            "milnce_serve_shed_total",
+            "requests refused at admission (HTTP 429)", ("reason",))
+        reg.gauge("milnce_serve_admission_inflight",
+                  "rows admitted and not yet resolved",
+                  fn=lambda: float(self.inflight))
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def observe_flush(self, dur_ms: float, rows: int) -> None:
+        """Fed from the batcher's ``on_flush`` hook: tracks the fastest
+        flush (the provable floor) and an EWMA (the Retry-After hint)."""
+        with self._lock:
+            self._flush_floor_ms = dur_ms if self._flush_floor_ms is None \
+                else min(self._flush_floor_ms, dur_ms)
+            self._flush_mean_ms = dur_ms if self._flush_mean_ms is None \
+                else 0.8 * self._flush_mean_ms + 0.2 * dur_ms
+
+    def _shed(self, reason: str, msg: str, retry_after_ms: float):
+        self._f_shed.labels(reason=reason).inc()
+        raise ShedError(msg, reason, retry_after_ms)
+
+    @contextlib.contextmanager
+    def admit(self, rows: int, timeout_ms: Optional[float]):
+        """Reserve ``rows`` slots for the duration of the request, or
+        refuse with :class:`ShedError` — the refusal happens BEFORE
+        anything is queued, so a shed request costs nothing downstream
+        and can never hang."""
+        rows = int(rows)
+        shed = None
+        with self._lock:
+            if (self.max_inflight > 0
+                    and self._inflight + rows > self.max_inflight):
+                hint = self._flush_mean_ms or 100.0
+                shed = ("overload",
+                        f"{self._inflight} rows in flight + {rows} would "
+                        f"exceed max_inflight={self.max_inflight}", hint)
+            elif self.max_inflight > 0 and timeout_ms and timeout_ms > 0 \
+                    and self._flush_floor_ms is not None \
+                    and self._depth_fn is not None:
+                batches_ahead = math.ceil(self._depth_fn() / self.max_batch)
+                floor_ms = (batches_ahead / self.lanes) \
+                    * self._flush_floor_ms
+                if floor_ms > float(timeout_ms):
+                    shed = ("deadline_infeasible",
+                            f"deadline {timeout_ms:.0f} ms < provable "
+                            f"queue-wait floor {floor_ms:.0f} ms "
+                            f"({batches_ahead} batches ahead)", floor_ms)
+            if shed is None:
+                self._inflight += rows
+        if shed is not None:
+            self._shed(*shed)
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._inflight -= rows
+
+    def stats(self) -> dict:
+        with self._lock:
+            inflight = self._inflight
+            floor = self._flush_floor_ms
+        return {
+            "max_inflight": self.max_inflight,
+            "inflight": inflight,
+            "flush_floor_ms": floor,
+            "shed": {str(labels[0]): int(child.value)
+                     for labels, child in self._f_shed.items()},
+        }
+
+
 class RetrievalService:
     """Programmatic API over engine + batcher + cache + index."""
 
@@ -79,11 +232,16 @@ class RetrievalService:
                  max_delay_ms: float = 5.0, default_timeout_ms: float = 0.0,
                  registry: Optional[obs_metrics.MetricsRegistry] = None,
                  recorder: Optional[obs_spans.SpanRecorder] = None,
-                 capture=None, anomaly_ratio: float = 3.0):
+                 capture=None, anomaly_ratio: float = 3.0,
+                 max_inflight: int = 0):
         self.engine = engine
         self.index = index
         self.tokenizer = tokenizer
         self.cache = cache if cache is not None else EmbeddingLRUCache(0)
+        # engine may be a single InferenceEngine or a ReplicaPool —
+        # the pool adds the Future-returning submit surface (pipelined
+        # batcher flushes) and per-replica health (serving/pool.py)
+        self._pool = engine if hasattr(engine, "pool_stats") else None
         # Anomaly-triggered profiler capture (obs/anomaly.py + obs/
         # capture.py): an EWMA detector watches per-flush latency (fed
         # by the batcher worker) and — when a ProfilerCapture is
@@ -107,14 +265,50 @@ class RetrievalService:
         # in the same process — must divert this service's spans and the
         # ``/obs/events`` ring together, never split them
         self._recorder = recorder
+        # admission controller (the bounded global queue + feasibility
+        # shed): max_inflight=0 keeps the overload bound off but the
+        # controller still meters in-flight rows for /healthz
+        self._admission = AdmissionController(
+            max_inflight, max_batch=engine.max_batch,
+            lanes=(len(self._pool.replicas) if self._pool is not None else 1),
+            depth_fn=lambda: self._batcher.depth(),
+            registry=self.registry)
+
+        def _on_flush(dur_ms: float, rows: int) -> None:
+            # one hook, two consumers: the EWMA spike detector (anomaly
+            # -> profiler capture) and — single-engine mode only — the
+            # admission feasibility floor (a sync flush IS the dispatch;
+            # a pooled async flush spans replica queue wait too, so the
+            # pooled floor feeds from the pool's dispatch latencies
+            # below instead)
+            self._flush_detector.observe(dur_ms, rows=rows)
+            if self._pool is None:
+                self._admission.observe_flush(dur_ms, rows)
+
         self._batcher = DynamicBatcher(
             engine.embed_text, engine.bucket_for, max_batch=engine.max_batch,
             max_delay_ms=max_delay_ms, default_timeout_ms=default_timeout_ms,
             name="text", registry=self.registry, buckets=engine.buckets,
-            recorder=recorder,
-            on_flush=lambda dur_ms, rows: self._flush_detector.observe(
-                dur_ms, rows=rows))
+            recorder=recorder, on_flush=_on_flush,
+            # pooled: submit-and-move-on so batches pipeline across
+            # replicas and one wedged replica never blocks the flush loop
+            run_batch_async=(self._pool.submit_text
+                             if self._pool is not None else None))
+        if self._pool is not None:
+            # the pool's per-dispatch latencies feed the same spike
+            # detector (the anomaly->capture path sees replica-level
+            # slowness even when batcher queueing hides it) AND the
+            # admission feasibility floor (pure execution time — the
+            # honest "fastest the service has ever dispatched")
+            def _on_dispatch(dur_ms: float, rows: int) -> None:
+                self._flush_detector.observe(dur_ms, rows=rows)
+                self._admission.observe_flush(dur_ms, rows)
+
+            self._pool.set_on_latency(_on_dispatch)
         self._default_timeout_ms = float(default_timeout_ms)
+        self._m_degraded = self.registry.counter(
+            "milnce_serve_degraded_total",
+            "requests refused in degraded mode (HTTP 503)", ("reason",))
         self._started = time.time()  # graftlint: disable=GL005(wall-clock uptime bookkeeping for /healthz + the uptime gauge — deliberate wall time, not a device-timing delta; audited when main()'s jax import put this file in GL005 scope)
         reg = self.registry
         self._m_queries = reg.counter(
@@ -147,21 +341,44 @@ class RetrievalService:
     def embed_text_ids(self, token_ids: np.ndarray,
                        timeout_ms: Optional[float] = None) -> np.ndarray:
         """(n, W) int32 -> (n, D): cache hits answered on host, misses
-        batched through the engine; results land back in the cache."""
+        batched through the engine; results land back in the cache.
+
+        Admission runs FIRST (a shed request touches neither cache nor
+        queue); a miss that fails because no replica is healthy becomes
+        :class:`DegradedError` — the degradation ladder's cache-only
+        tier (an all-hit request still succeeds because it never reaches
+        the batcher)."""
         rows = np.ascontiguousarray(token_ids, dtype=np.int32)
         if rows.ndim != 2:
             raise ValueError(f"expected (n, W) token ids, got {rows.shape}")
-        keys = [token_key(r) for r in rows]
-        out: list[Optional[np.ndarray]] = [self.cache.get(k) for k in keys]
-        pending = [(i, self._batcher.submit(rows[i], timeout_ms))
-                   for i, hit in enumerate(out) if hit is None]
-        wait = self._result_wait_s(timeout_ms)
-        for i, fut in pending:
-            row = fut.result(timeout=wait)
-            self.cache.put(keys[i], row)
-            out[i] = row
-        return np.stack(out) if out else np.zeros(
-            (0, self.engine.embed_dim or 0), np.float32)
+        # admission judges the EFFECTIVE deadline (the batcher applies
+        # default_timeout_ms to a None request deadline, so feasibility
+        # must see the same number — a raw None would silently disable
+        # the check for every default-deadline client)
+        eff_timeout_ms = (self._default_timeout_ms if timeout_ms is None
+                          else float(timeout_ms))
+        with self._admission.admit(rows.shape[0], eff_timeout_ms):
+            keys = [token_key(r) for r in rows]
+            out: list[Optional[np.ndarray]] = [self.cache.get(k)
+                                               for k in keys]
+            pending = [(i, self._batcher.submit(rows[i], timeout_ms))
+                       for i, hit in enumerate(out) if hit is None]
+            wait = self._result_wait_s(timeout_ms)
+            for i, fut in pending:
+                try:
+                    row = fut.result(timeout=wait)
+                except PoolUnavailable as exc:
+                    reason = ("cache_only" if self.cache.capacity > 0
+                              else exc.reason)
+                    self._m_degraded.labels(reason=reason).inc()
+                    raise DegradedError(
+                        f"no healthy replica to embed this request "
+                        f"({exc}); cache hits are still served",
+                        reason) from exc
+                self.cache.put(keys[i], row)
+                out[i] = row
+            return np.stack(out) if out else np.zeros(
+                (0, self.engine.embed_dim or 0), np.float32)
 
     def _result_wait_s(self, timeout_ms: Optional[float]) -> Optional[float]:
         t_ms = (self._default_timeout_ms if timeout_ms is None
@@ -190,6 +407,8 @@ class RetrievalService:
         try:
             emb = self.embed_text_ids(token_ids, timeout_ms)
             scores, idx = self.index.topk(emb)
+        except (ShedError, DegradedError, PoolSaturated, PoolUnavailable):
+            raise        # refusals, not failures: counted on their own
         except Exception:
             self._m_errors.inc(len(token_ids))
             raise
@@ -206,7 +425,7 @@ class RetrievalService:
         """The pre-registry ``/healthz`` contract, keys unchanged —
         every value now reads the obs registry (or a component stats()
         that itself reads the registry)."""
-        return {
+        out = {
             "status": "ok",
             "uptime_s": time.time() - self._started,
             "queries": int(self._m_queries.value),
@@ -215,7 +434,14 @@ class RetrievalService:
             "batcher": self._batcher.stats(),
             "cache": self.cache.stats(),
             "index": self.index.stats() if self.index is not None else None,
+            "admission": self._admission.stats(),
         }
+        if self._pool is not None:
+            # per-replica state / outstanding / last-probe age + the
+            # pool resilience counters (additive key — every
+            # pre-existing /healthz key above is byte-compatible)
+            out["pool"] = self._pool.pool_stats()
+        return out
 
     def metrics_text(self) -> str:
         """Prometheus text exposition of the service registry."""
@@ -247,12 +473,33 @@ class _Handler(BaseHTTPRequestHandler):
         body = json.dumps(payload).encode()
         self._reply_raw(code, body, "application/json")
 
-    def _reply_raw(self, code: int, body: bytes, content_type: str) -> None:
+    def _reply_raw(self, code: int, body: bytes, content_type: str,
+                   retry_after_ms: Optional[float] = None) -> None:
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        if retry_after_ms is not None:
+            # Retry-After is whole seconds (RFC 9110); round UP so the
+            # client never retries before the hinted wait
+            self.send_header("Retry-After",
+                             str(max(1, math.ceil(retry_after_ms / 1000.0))))
         self.end_headers()
         self.wfile.write(body)
+
+    def _refuse(self, code: int, kind: str, exc: Exception,
+                reason: Optional[str] = None) -> None:
+        """The structured refusal contract (SERVING.md): JSON body with
+        ``error``/``kind``/``reason``/``retry_after_ms`` + a real
+        ``Retry-After`` header — machine-actionable, never a bare
+        string or a socket hang."""
+        retry_ms = float(getattr(exc, "retry_after_ms", 1000.0)) or 1000.0
+        payload = {"error": str(exc), "kind": kind,
+                   "retry_after_ms": round(retry_ms, 1)}
+        if reason is not None:
+            payload["reason"] = reason
+        body = json.dumps(payload).encode()
+        self._reply_raw(code, body, "application/json",
+                        retry_after_ms=retry_ms)
 
     def do_GET(self) -> None:
         from urllib.parse import parse_qs, urlparse
@@ -320,8 +567,15 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._reply(404, {"error": f"no route {self.path!r}"})
         except DeadlineExpired as exc:
-            self._reply(504, {"error": str(exc),
-                              "kind": "deadline_expired"})
+            self._refuse(504, "deadline_expired", exc)
+        except ShedError as exc:
+            self._refuse(429, "shed", exc, reason=exc.reason)
+        except PoolSaturated as exc:
+            self._refuse(429, "shed", exc, reason="replica_queues_full")
+        except DegradedError as exc:
+            self._refuse(503, "degraded", exc, reason=exc.reason)
+        except PoolUnavailable as exc:
+            self._refuse(503, "degraded", exc, reason=exc.reason)
         except (ValueError, KeyError, json.JSONDecodeError) as exc:
             self._reply(400, {"error": str(exc)})
         except Exception as exc:
@@ -380,10 +634,23 @@ def main(argv=None) -> None:
                          "artifact directory)")
     initialize_distributed(cfg.parallel)
     mesh = build_mesh(cfg.parallel)
-    engine = InferenceEngine.from_export(s.export_dir, mesh, dtype=s.dtype,
-                                         max_batch=s.max_batch,
-                                         min_bucket=s.min_bucket,
-                                         data_axis=cfg.parallel.data_axis)
+    if s.replicas > 1:
+        from milnce_tpu.serving.pool import ReplicaPool
+
+        engine = ReplicaPool.from_export(
+            s.export_dir, s.replicas, dtype=s.dtype,
+            max_batch=s.max_batch, min_bucket=s.min_bucket,
+            data_axis=cfg.parallel.data_axis,
+            queue_depth=s.replica_queue_depth,
+            error_threshold=s.error_threshold, slo_ms=s.slo_ms,
+            slo_breaches=s.slo_breaches,
+            probe_interval_s=s.probe_interval_s,
+            hedge_quantile=s.hedge_quantile, hedge_min_ms=s.hedge_min_ms,
+            max_requeues=s.max_requeues, registry=obs_metrics.registry())
+    else:
+        engine = InferenceEngine.from_export(
+            s.export_dir, mesh, dtype=s.dtype, max_batch=s.max_batch,
+            min_bucket=s.min_bucket, data_axis=cfg.parallel.data_axis)
     # sentence requests need a vocab: --serve.token_dict_path wins, else
     # the path the export recorded; with neither, token_ids-only (400s
     # on "sentences" explain themselves)
@@ -436,11 +703,13 @@ def main(argv=None) -> None:
         # the live process has ONE registry: /metrics on this server
         # also exposes anything other subsystems record process-wide
         registry=obs_metrics.registry(),
-        capture=capture, anomaly_ratio=s.anomaly_ratio)
+        capture=capture, anomaly_ratio=s.anomaly_ratio,
+        max_inflight=s.max_inflight)
     server = serve_http(service, s.host, s.port)
     # flush: operators poll a redirected log for this readiness line
     print(f"milnce-serve: listening on http://{s.host}:"
           f"{server.server_address[1]} (buckets {engine.buckets}, "
+          f"replicas={s.replicas}, "
           f"index={'none' if index is None else index.size}, "
           f"tokenizer={'yes' if tokenizer else 'token_ids-only'}; "
           f"Prometheus scrape: /metrics)",
@@ -450,6 +719,8 @@ def main(argv=None) -> None:
     finally:
         server.server_close()
         service.close()
+        if s.replicas > 1:
+            engine.close()
 
 
 if __name__ == "__main__":

@@ -72,6 +72,11 @@ GATE_DIRECTIONS = {
     # badput (skips, data waits) fails here
     "mfu": "higher",
     "goodput_fraction": "higher",
+    # serving resilience tier (ISSUE 10): the UNSTRUCTURED failure
+    # fraction of a serve_bench run (structured refusals — 429/503/504 —
+    # are counted separately and do NOT gate here); chaos benches pin
+    # error-rate drift with this
+    "error_rate": "lower",
 }
 
 
@@ -169,7 +174,7 @@ def gate_metrics(artifact: dict) -> dict[str, float]:
             out[dst] = float(v)
     for key in ("qps", "clips_per_sec_per_chip",
                 "predicted_peak_bytes_per_chip", "mfu",
-                "goodput_fraction"):
+                "goodput_fraction", "error_rate"):
         v = doc.get(key)
         if isinstance(v, (int, float)):
             out[key] = float(v)

@@ -45,13 +45,16 @@ sys.path.insert(0, _REPO)
 
 def build_service(args):
     """Tiny-preset service stack: random frozen params (or an export),
-    synthetic video corpus, programmatic API only."""
+    synthetic video corpus, programmatic API only.  ``--replicas N``
+    builds a ReplicaPool (N single-device engines on the CPU backend)
+    instead of one engine — the chaos-bench configuration."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from milnce_tpu.config import PRESETS
     from milnce_tpu.models.build import build_model
+    from milnce_tpu.obs import metrics as obs_metrics
     from milnce_tpu.parallel.mesh import build_mesh
     from milnce_tpu.serving.cache import EmbeddingLRUCache
     from milnce_tpu.serving.engine import InferenceEngine
@@ -62,20 +65,46 @@ def build_service(args):
     mesh = build_mesh(cfg.parallel)
     video_shape = (cfg.data.num_frames, cfg.data.video_size,
                    cfg.data.video_size, 3)
+    registry = obs_metrics.MetricsRegistry()
+    pool_kwargs = dict(
+        queue_depth=args.replica_queue_depth,
+        error_threshold=args.error_threshold,
+        probe_interval_s=args.probe_interval_s,
+        hedge_quantile=args.hedge_quantile,
+        hedge_min_ms=args.hedge_min_ms,
+        max_requeues=args.max_requeues, registry=registry)
     if args.export_dir:
-        engine = InferenceEngine.from_export(args.export_dir, mesh,
-                                             max_batch=args.max_batch)
+        if args.replicas > 1:
+            from milnce_tpu.serving.pool import ReplicaPool
+
+            engine = ReplicaPool.from_export(
+                args.export_dir, args.replicas, max_batch=args.max_batch,
+                min_bucket=args.min_bucket, **pool_kwargs)
+        else:
+            engine = InferenceEngine.from_export(args.export_dir, mesh,
+                                                 max_batch=args.max_batch,
+                                                 min_bucket=args.min_bucket)
     else:
         model = build_model(cfg.model)
         variables = model.init(
             jax.random.PRNGKey(0),
             jnp.zeros((1,) + video_shape, jnp.float32),
             jnp.zeros((1, cfg.data.max_words), jnp.int32))
-        engine = InferenceEngine(
-            model, {"params": variables["params"],
-                    "batch_stats": variables.get("batch_stats", {})},
-            mesh, text_words=cfg.data.max_words, video_shape=video_shape,
-            max_batch=args.max_batch)
+        frozen = {"params": variables["params"],
+                  "batch_stats": variables.get("batch_stats", {})}
+        if args.replicas > 1:
+            from milnce_tpu.serving.pool import ReplicaPool
+
+            engine = ReplicaPool.build(
+                model, frozen, args.replicas,
+                text_words=cfg.data.max_words, video_shape=video_shape,
+                max_batch=args.max_batch, min_bucket=args.min_bucket,
+                **pool_kwargs)
+        else:
+            engine = InferenceEngine(
+                model, frozen, mesh, text_words=cfg.data.max_words,
+                video_shape=video_shape, max_batch=args.max_batch,
+                min_bucket=args.min_bucket)
 
     # synthetic corpus, embedded through the engine in bucket-sized chunks
     rng = np.random.default_rng(0)
@@ -91,7 +120,8 @@ def build_service(args):
     service = RetrievalService(
         engine, index, cache=EmbeddingLRUCache(args.cache_capacity),
         max_delay_ms=args.max_delay_ms,
-        default_timeout_ms=args.timeout_ms)
+        default_timeout_ms=args.timeout_ms, registry=registry,
+        max_inflight=args.max_inflight)
     return cfg, service
 
 
@@ -121,38 +151,60 @@ def make_query_draw(cfg, distinct: int):
     return draw
 
 
+def _make_issue(service, lats: list, counters: dict,
+                lock: threading.Lock):
+    """-> ``issue(row)``: one query with the full refusal taxonomy
+    counted — expired (504), shed (429), degraded (503) are STRUCTURED
+    refusals, ``errors`` is everything unstructured.  Every branch
+    returns; nothing can hang a worker."""
+    from milnce_tpu.serving.batcher import DeadlineExpired
+    from milnce_tpu.serving.pool import PoolSaturated, PoolUnavailable
+    from milnce_tpu.serving.service import DegradedError, ShedError
+
+    def issue(row) -> None:
+        t0 = time.perf_counter()
+        try:
+            service.query_ids(row[None, :])
+        except DeadlineExpired:
+            with lock:
+                counters["deadline_expired"] += 1
+        except (ShedError, PoolSaturated):
+            with lock:
+                counters["shed"] += 1
+        except (DegradedError, PoolUnavailable):
+            with lock:
+                counters["degraded"] += 1
+        except Exception:
+            with lock:
+                counters["errors"] += 1
+        else:
+            dt = time.perf_counter() - t0
+            with lock:
+                lats.append(dt)
+
+    return issue
+
+
+def new_counters() -> dict:
+    return {"errors": 0, "deadline_expired": 0, "shed": 0, "degraded": 0}
+
+
 def run_closed_loop(service, draw, duration: float,
                     concurrency: int):
     """Each worker issues the next query on completion; returns
-    (latencies_s, errors, expired)."""
+    (latencies_s, counters)."""
     import numpy as np
 
-    from milnce_tpu.serving.batcher import DeadlineExpired
-
     lats: list[float] = []
-    errors = [0]
-    expired = [0]
+    counters = new_counters()
     lock = threading.Lock()
+    issue = _make_issue(service, lats, counters, lock)
     t_end = time.monotonic() + duration
 
     def worker(wid: int):
         rng = np.random.default_rng(1000 + wid)
         while time.monotonic() < t_end:
-            row = draw(rng)
-            t0 = time.perf_counter()
-            try:
-                service.query_ids(row[None, :])
-            except DeadlineExpired:
-                with lock:
-                    expired[0] += 1
-                continue
-            except Exception:
-                with lock:
-                    errors[0] += 1
-                continue
-            dt = time.perf_counter() - t0
-            with lock:
-                lats.append(dt)
+            issue(draw(rng))
 
     threads = [threading.Thread(target=worker, args=(i,), daemon=True)
                for i in range(concurrency)]
@@ -160,7 +212,7 @@ def run_closed_loop(service, draw, duration: float,
         t.start()
     for t in threads:
         t.join()
-    return lats, errors[0], expired[0]
+    return lats, counters
 
 
 def run_open_loop(service, draw, duration: float, qps: float):
@@ -168,30 +220,12 @@ def run_open_loop(service, draw, duration: float, qps: float):
     (requests keep arriving whether or not earlier ones finished)."""
     import numpy as np
 
-    from milnce_tpu.serving.batcher import DeadlineExpired
-
     lats: list[float] = []
-    errors = [0]
-    expired = [0]
+    counters = new_counters()
     lock = threading.Lock()
+    issue = _make_issue(service, lats, counters, lock)
     rng = np.random.default_rng(11)
     inflight: list[threading.Thread] = []
-
-    def one(row):
-        t0 = time.perf_counter()
-        try:
-            service.query_ids(row[None, :])
-        except DeadlineExpired:
-            with lock:
-                expired[0] += 1
-            return
-        except Exception:
-            with lock:
-                errors[0] += 1
-            return
-        dt = time.perf_counter() - t0
-        with lock:
-            lats.append(dt)
 
     t_end = time.monotonic() + duration
     next_arrival = time.monotonic()
@@ -201,13 +235,12 @@ def run_open_loop(service, draw, duration: float, qps: float):
             time.sleep(min(next_arrival - now, 0.01))
             continue
         next_arrival += rng.exponential(1.0 / qps)
-        row = draw(rng)
-        t = threading.Thread(target=one, args=(row,), daemon=True)
+        t = threading.Thread(target=issue, args=(draw(rng),), daemon=True)
         t.start()
         inflight.append(t)
     for t in inflight:
         t.join(timeout=30.0)
-    return lats, errors[0], expired[0]
+    return lats, counters
 
 
 def main(argv=None) -> int:
@@ -234,11 +267,39 @@ def main(argv=None) -> int:
     ap.add_argument("--topk", type=int, default=5)
     ap.add_argument("--max_batch", type=int, default=16,
                     help="top bucket (taller ladders compile longer)")
+    ap.add_argument("--min_bucket", type=int, default=0,
+                    help="bottom bucket (0 = mesh/replica-group size; "
+                         "raise it to shrink the ladder's compile bill — "
+                         "single-device pool replicas otherwise start "
+                         "their ladder at 1)")
     ap.add_argument("--max_delay_ms", type=float, default=3.0)
     ap.add_argument("--timeout_ms", type=float, default=0.0)
     ap.add_argument("--cache_capacity", type=int, default=4096)
     ap.add_argument("--export_dir", default="",
                     help="serve a milnce-export instead of random params")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="engine replica pool size (>1 = ReplicaPool; on "
+                         "the cpu backend the virtual device count is "
+                         "forced to match)")
+    ap.add_argument("--replica_queue_depth", type=int, default=16)
+    ap.add_argument("--error_threshold", type=int, default=3)
+    ap.add_argument("--probe_interval_s", type=float, default=0.5)
+    ap.add_argument("--hedge_quantile", type=float, default=0.0,
+                    help="hedge dispatches past this latency quantile to "
+                         "a second replica (0 = off)")
+    ap.add_argument("--hedge_min_ms", type=float, default=20.0)
+    ap.add_argument("--max_requeues", type=int, default=1,
+                    help="failed dispatches retried on another replica "
+                         "before the caller sees the error")
+    ap.add_argument("--max_inflight", type=int, default=0,
+                    help="admission bound: rows in flight before requests "
+                         "shed with 429 (0 = unbounded)")
+    ap.add_argument("--faults", default="",
+                    help="fault-injection spec (resilience/faults.py "
+                         "grammar, e.g. 'serve.dispatch_raise@%%5;"
+                         "serve.replica_dead@40').  Armed AFTER warmup — "
+                         "the measurement window is the chaos window — "
+                         "and exported as MILNCE_FAULTS for any child")
     ap.add_argument("--out", default="",
                     help="report path (default "
                          "SERVE_BENCH_<preset>_<mode>.json at repo root)")
@@ -246,6 +307,14 @@ def main(argv=None) -> int:
 
     if args.backend == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if (args.replicas > 1
+                and "xla_force_host_platform_device_count" not in flags):
+            # a pool needs one device per replica on the CPU backend;
+            # must land before jax initializes its backends
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{args.replicas}").strip()
     import numpy as np
 
     t0 = time.monotonic()
@@ -253,16 +322,28 @@ def main(argv=None) -> int:
     warmup_s = time.monotonic() - t0
     draw = make_query_draw(cfg, args.distinct)
 
+    if args.faults:
+        # armed AFTER build/warmup: occurrences count from the first
+        # measured request, so a spec like @%5 is reproducible and the
+        # compile sweep can't eat scheduled occurrences
+        from milnce_tpu.resilience import faults
+
+        os.environ[faults.ENV_VAR] = args.faults
+        faults.arm(args.faults)
+
     t_run = time.monotonic()
     if args.mode == "closed":
-        lats, errors, expired = run_closed_loop(
+        lats, counters = run_closed_loop(
             service, draw, args.duration, args.concurrency)
     else:
-        lats, errors, expired = run_open_loop(
+        lats, counters = run_open_loop(
             service, draw, args.duration, args.qps)
     elapsed = time.monotonic() - t_run
+    errors, expired = counters["errors"], counters["deadline_expired"]
     health = service.health()
     service.close()
+    if args.replicas > 1:
+        service.engine.close()
 
     lat_ms = np.asarray(sorted(lats), np.float64) * 1e3
     pct = (lambda q: float(np.percentile(lat_ms, q))) if len(lat_ms) else (
@@ -278,6 +359,18 @@ def main(argv=None) -> int:
         "requests": len(lats),
         "errors": errors,
         "deadline_expired": expired,
+        # the chaos-bench taxonomy: shed (429) / degraded (503) are
+        # structured refusals, requeued/hedged/quarantines/recoveries
+        # come from the pool's resilience counters; error_rate is the
+        # UNSTRUCTURED failure fraction and an obs_report gate metric
+        # (lower is better) so chaos runs can gate error-rate drift
+        "resilience": {
+            **{k: counters[k] for k in ("shed", "degraded")},
+            **(service.engine.counts() if args.replicas > 1 else {}),
+        },
+        "error_rate": round(
+            errors / max(1, len(lats) + errors + expired
+                         + counters["shed"] + counters["degraded"]), 5),
         "qps": round(len(lats) / elapsed, 2) if elapsed > 0 else 0.0,
         "latency_ms": {
             "p50": round(pct(50), 3), "p95": round(pct(95), 3),
@@ -293,6 +386,8 @@ def main(argv=None) -> int:
         "cache": health["cache"],
         "engine": health["engine"],
         "index": health["index"],
+        "admission": health["admission"],
+        "pool": health.get("pool"),
     }
     # the versioned obs snapshot (OBSERVABILITY.md): registry metrics
     # (request counters, per-bucket occupancy, collect-time gauges) plus
@@ -311,10 +406,15 @@ def main(argv=None) -> int:
         _REPO, f"SERVE_BENCH_{args.preset}_{args.mode}.json")
     with open(out, "w") as fh:
         json.dump(report, fh, indent=2, sort_keys=True)
+    res = report["resilience"]
     print(f"serve_bench: {report['requests']} requests in {elapsed:.2f}s "
           f"({report['qps']} QPS), p50={report['latency_ms']['p50']}ms "
           f"p99={report['latency_ms']['p99']}ms, cache hit rate "
           f"{report['cache']['hit_rate']:.2f}, "
+          f"errors={report['errors']} expired={report['deadline_expired']} "
+          f"shed={res['shed']} degraded={res['degraded']} "
+          f"requeued={res.get('requeued', 0)} hedged={res.get('hedged', 0)} "
+          f"quarantines={res.get('quarantines', 0)}, "
           f"recompiles={report['engine']['recompiles']} -> {out}")
     return 0 if report["engine"]["recompiles"] in (0, -1) else 1
 

@@ -1,12 +1,14 @@
 """Child process of the lockrt serving hammer (tests/test_lockrt.py).
 
-Runs the FULL serving stack — bucketed engine, dynamic batcher,
-embedding cache, device-resident index, HTTP front, Prometheus scrape —
-with ``MILNCE_LOCK_SANITIZE=1`` exported by the parent BEFORE import,
-so every lock in the mesh (including the module-level
-DEVICE_DISPATCH_LOCK) is an order-checking SanitizedLock.  16 threads
-mix query / embed / healthz / metrics / events traffic; any lock-order
-cycle, self-deadlock or sanitizer failure raises and fails the child.
+Runs the FULL serving stack — a 2-replica engine POOL (per-replica
+dispatch locks, pool state lock, probe thread — ISSUE 10), dynamic
+batcher in pipelined mode, embedding cache, device-resident index
+(still behind the module-level DEVICE_DISPATCH_LOCK), HTTP front,
+Prometheus scrape — with ``MILNCE_LOCK_SANITIZE=1`` exported by the
+parent BEFORE import, so every lock in the mesh is an order-checking
+SanitizedLock.  16 threads mix query / embed / healthz / metrics /
+events traffic; any lock-order cycle, self-deadlock or sanitizer
+failure raises and fails the child.
 
 Model/engine dimensions deliberately match tests/test_serving.py's
 module stack so the persistent jax compilation cache (conftest wiring,
@@ -49,8 +51,8 @@ from milnce_tpu.models import S3D  # noqa: E402
 from milnce_tpu.obs import metrics as obs_metrics  # noqa: E402
 from milnce_tpu.serving import engine as engine_mod  # noqa: E402
 from milnce_tpu.serving.cache import EmbeddingLRUCache  # noqa: E402
-from milnce_tpu.serving.engine import InferenceEngine  # noqa: E402
 from milnce_tpu.serving.index import DeviceRetrievalIndex  # noqa: E402
+from milnce_tpu.serving.pool import ReplicaPool  # noqa: E402
 from milnce_tpu.serving.service import (RetrievalService,  # noqa: E402
                                         serve_http)
 
@@ -69,19 +71,28 @@ def main() -> int:
                            jnp.zeros((1, _FRAMES, _SIZE, _SIZE, 3)),
                            jnp.zeros((1, _WORDS), jnp.int32))
     mesh = Mesh(np.array(jax.devices()), ("data",))
-    engine = InferenceEngine(model, dict(variables), mesh,
+    # ISSUE 10: the hammer drives the POOL — 16 request threads against
+    # 2 single-device replicas (own dispatch locks + workers + probe
+    # thread) while the index still serializes on the process-wide
+    # DEVICE_DISPATCH_LOCK; the whole lock mesh is sanitized
+    pool = ReplicaPool.build(model, dict(variables), 2,
                              text_words=_WORDS,
                              video_shape=(_FRAMES, _SIZE, _SIZE, 3),
-                             max_batch=16)
-    assert isinstance(engine._stats_lock, lockrt.SanitizedLock)
+                             max_batch=16, min_bucket=8,
+                             probe_interval_s=0.5,
+                             registry=obs_metrics.registry())
+    assert isinstance(pool._state_lock, lockrt.SanitizedLock)
+    for r in pool.replicas:
+        assert isinstance(r.engine._dispatch_lock, lockrt.SanitizedLock)
+        assert isinstance(r.engine._stats_lock, lockrt.SanitizedLock)
     rng = np.random.default_rng(0)
     clips = rng.integers(0, 255, (_CORPUS, _FRAMES, _SIZE, _SIZE, 3),
                          dtype=np.uint8)
     corpus = np.concatenate(
-        [engine.embed_video(clips[:16]), engine.embed_video(clips[16:])])
+        [pool.embed_video(clips[:16]), pool.embed_video(clips[16:])])
     index = DeviceRetrievalIndex(mesh, corpus, k=5,
-                                 query_buckets=engine.buckets)
-    service = RetrievalService(engine, index,
+                                 query_buckets=pool.buckets)
+    service = RetrievalService(pool, index,
                                cache=EmbeddingLRUCache(128),
                                max_delay_ms=2.0,
                                registry=obs_metrics.registry())
@@ -128,12 +139,13 @@ def main() -> int:
     server.shutdown()
     server.server_close()
     service.close()
+    pool.close()
 
     if errors:
         print("\n".join(errors), file=sys.stderr)
         return 1
-    if engine.recompiles() != 0:
-        print(f"recompiles={engine.recompiles()} != 0", file=sys.stderr)
+    if pool.recompiles() != 0:
+        print(f"pool recompiles={pool.recompiles()} != 0", file=sys.stderr)
         return 1
     edges = lockrt.GLOBAL_GRAPH.snapshot()["edges"]
     if not edges:
@@ -141,7 +153,7 @@ def main() -> int:
               file=sys.stderr)
         return 1
     print(f"HAMMER_OK threads={N_THREADS} ops={OPS_PER_THREAD} "
-          f"edges={len(edges)}")
+          f"edges={len(edges)} replicas={len(pool.replicas)}")
     print(json.dumps({"edges": edges}, indent=1))
     return 0
 

@@ -281,6 +281,59 @@ class TestService:
         flushes = service.health()["batcher"]["flushes"]
         assert flushes < _CORPUS
 
+    def test_pooled_serving_matches_single_engine_and_offline_ranking(
+            self, stack):
+        """ISSUE 10 parity pin: pooled serving (2 single-device replicas,
+        concurrent request threads, hedge/requeue machinery in place)
+        returns rankings EXACTLY equal to the single-engine path and the
+        offline argsort for the same queries — replicas are exact peers
+        of the 8-device engine (the embed programs are collective-free
+        row-wise maps, so device-group shape cannot change the math)."""
+        from milnce_tpu.obs import metrics as obs_metrics
+        from milnce_tpu.serving.cache import EmbeddingLRUCache
+        from milnce_tpu.serving.pool import ReplicaPool
+        from milnce_tpu.serving.service import RetrievalService
+
+        engine, index = stack["engine"], stack["index"]
+        rng = np.random.default_rng(9)
+        texts = rng.integers(1, 64, (_CORPUS, _WORDS)).astype(np.int32)
+        t_emb = np.concatenate([engine.embed_text(texts[:16]),
+                                engine.embed_text(texts[16:])])
+        offline = np.argsort(-(t_emb @ stack["corpus_emb"].T),
+                             axis=1)[:, :5]
+        single = np.stack([stack["service"].query_ids(texts[i:i + 1])[1][0]
+                           for i in range(_CORPUS)])
+        pool = ReplicaPool.build(
+            stack["model"], dict(stack["variables"]), 2,
+            text_words=_WORDS, video_shape=(_FRAMES, _SIZE, _SIZE, 3),
+            max_batch=8, min_bucket=4,
+            registry=obs_metrics.MetricsRegistry())
+        service = RetrievalService(pool, index,
+                                   cache=EmbeddingLRUCache(0),
+                                   max_delay_ms=3.0)
+        try:
+            results = [None] * _CORPUS
+
+            def one(i):
+                _, idx = service.query_ids(texts[i:i + 1])
+                results[i] = idx[0]
+
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(_CORPUS)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            served = np.stack(results)
+            assert np.array_equal(served, offline), (
+                "pooled top-k diverged from the offline eval ranking")
+            assert np.array_equal(served, single), (
+                "pooled top-k diverged from the single-engine path")
+            assert pool.recompiles() == 0
+        finally:
+            service.close()
+            pool.close()
+
     def test_cache_hits_skip_the_device(self, stack):
         service = stack["service"]
         ids = np.full((1, _WORDS), 7, np.int32)

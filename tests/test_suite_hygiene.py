@@ -44,6 +44,14 @@ _FAST_CHILD_EXEMPT = {
     # cache (dimensions match test_serving's stack), and the lock-order
     # gate pins it tier-1.
     "test_lockrt.py::test_serving_hammer_subprocess_under_sanitizer",
+    # ISSUE 10 acceptance: the closed-loop chaos bench — serve_bench
+    # --preset tiny --duration 2 with serve.dispatch_raise@%5 armed and
+    # one replica force-killed mid-run.  A subprocess because the chaos
+    # acceptance pin IS the real script end-to-end (fault arming, pool
+    # build, report schema); tiny preset + the shared persistent compile
+    # cache keep it seconds-scale, and the serving-chaos gate pins it
+    # tier-1.
+    "test_serve_chaos.py::test_chaos_serve_bench_closed_loop_acceptance",
 }
 
 
@@ -215,6 +223,30 @@ def test_serving_gates_exist_and_stay_tier1():
         assert not slow, (
             "serving tests must be tier-1/CPU-safe, never @slow (they "
             f"are the request-path regression fence): {fname}::{slow}")
+
+
+# serving-chaos gate (ISSUE 10): the replica-pool fault-injection tests
+# — per-site survival (raise/hang/dead), quarantine-then-recovery,
+# hedge determinism, shed-never-hangs, the HTTP error contract and the
+# closed-loop chaos bench — are the permanent regression harness for
+# serving-path failure isolation.  Same rule as every other gate:
+# tier-1, never @slow, never vanished.
+_SERVE_CHAOS_GATES = ("test_serve_chaos.py",)
+
+
+def test_serve_chaos_gates_exist_and_stay_tier1():
+    for fname in _SERVE_CHAOS_GATES:
+        path = os.path.join(_TESTS, fname)
+        assert os.path.exists(path), f"serving-chaos gate {fname} is missing"
+        src = open(path).read()
+        tests = list(_iter_tests(ast.parse(src)))
+        assert tests, f"{fname} defines no tests"
+        slow = [node.name for node, class_slow in tests
+                if _is_slow_marked(node, class_slow)]
+        assert not slow, (
+            "serving chaos tests must be tier-1/CPU-safe, never @slow "
+            "(they are the serving failure-isolation regression fence): "
+            f"{fname}::{slow}")
 
 
 # observability gates (ISSUE 5; ISSUE 9 added the attribution tier —

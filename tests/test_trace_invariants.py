@@ -34,7 +34,11 @@ def test_all_registered_entry_invariants_hold():
             "grad_cache_step_milnce", "video_embed", "text_embed",
             "softdtw_scan_grad", "param_treedef",
             "serve_embed_ladder", "serve_text_embed", "serve_video_embed",
-            "serve_index_topk"} <= entries
+            "serve_index_topk",
+            # ISSUE 10: pooled serving — per-replica ladder recompile pin
+            # + collective-free replica embed programs
+            "serve_pool_embed", "serve_pool_text_embed",
+            "serve_pool_video_embed"} <= entries
     # the double-call recompile detector ran on every executable entry
     recompiled = {r.entry for r in results if r.check == "recompile"}
     assert {"train_step_milnce", "train_step_milnce_guarded",
