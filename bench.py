@@ -229,7 +229,7 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
                   inner: int = 1, s2d: bool = False,
                   conv_impl: str = "native", conv_impl_map: str = "",
                   loss: str = "milnce", grad_accum: int = 1,
-                  mesh_spec: str = "",
+                  mesh_spec: str = "", loss_impl: str = "dense",
                   peak: float | None = None, flops_hint: float | None = None):
     """Time the full train step at one operating point.
 
@@ -281,6 +281,14 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
         cfg.loss.name = loss
         cfg.loss.sdtw_backend = "auto"   # Pallas where the measured
         loss_cfg = cfg.loss              # crossover says it wins
+    elif loss_impl != "dense":
+        # MIL-NCE impl axis (ISSUE 12): 'chunked'/'auto' swap the dense
+        # similarity cubes for the streaming loss (losses/
+        # milnce_chunked.py) inside the full compiled step; the row's
+        # predicted_peak_bytes_per_chip then carries the memory delta
+        # alongside the throughput cost (BENCH_MILNCE_LOSS.md)
+        cfg.loss.milnce_impl = loss_impl
+        loss_cfg = cfg.loss
     optimizer = build_optimizer(cfg.optim, build_schedule(cfg.optim, 1000))
 
     # Everything below runs ON DEVICE in three jitted programs.  The
@@ -485,6 +493,15 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
                 f"implausible measurement: {implied:.3e} FLOP/s implied "
                 f"(dt={dt:.6f}s for {inner} steps of >={guard_flops:.3e} "
                 f"FLOPs on {n_chips} chips, bound {bound:.3e})")
+    # record the EFFECTIVE loss impl: 'auto' resolves per shape
+    # (prefers_chunked at this row's per-chip batch), and a row the rule
+    # resolved to dense must not read as a streaming-loss measurement
+    effective_impl = loss_impl if loss == "milnce" else None
+    if effective_impl == "auto":
+        from milnce_tpu.losses.milnce_chunked import prefers_chunked
+
+        effective_impl = ("chunked" if prefers_chunked(
+            batch // n_chips, batch, k) else "dense")
     result = {
         "dtype": dtype,
         "batch": batch,
@@ -493,6 +510,9 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
         "conv_impl": conv_impl,
         "impl_map": conv_impl_map,
         "loss": loss,
+        "loss_impl": effective_impl,
+        "loss_impl_requested": (loss_impl if loss == "milnce"
+                                and loss_impl == "auto" else None),
         "grad_accum": grad_accum,
         "inner": inner,
         **mesh_fields,
@@ -662,7 +682,10 @@ def _make_record(best, frames, size, on_tpu, kind):
                   + (", fold2d convs"
                      if best.get("conv_impl") == "fold2d" else "")
                   + (", tuned impl map"
-                     if best.get("impl_map") else "") + ")",
+                     if best.get("impl_map") else "")
+                  + (", chunked loss"
+                     if best.get("loss_impl") not in (None, "dense")
+                     else "") + ")",
         "value": value,
         "unit": "clips/sec/chip",
         # ratio vs the recorded TPU anchor — only meaningful on TPU (a
@@ -730,6 +753,11 @@ def run_bench(on_tpu: bool, info: dict):
     # the default 1-D sweep a mesh_2d comparison row is auto-measured at
     # the winning operating point (opt out: MILNCE_BENCH_MESH_2D=0)
     mesh_spec = os.environ.get("MILNCE_BENCH_MESH", "")
+    # MIL-NCE loss impl for every sweep row: 'dense' (default), 'chunked'
+    # (streaming loss), or 'auto' (the prefers_chunked budget rule); with
+    # the default a milnce_chunked comparison row is auto-measured at the
+    # winning operating point (opt out: MILNCE_BENCH_MILNCE_CHUNKED=0)
+    loss_impl = os.environ.get("MILNCE_BENCH_LOSS_IMPL", "dense")
     if on_tpu:
         frames, size, words, k = 16, 224, 20, 5
         # differenced W(k2)-W(k1) timing cancels dispatch latency, so the
@@ -767,7 +795,7 @@ def run_bench(on_tpu: bool, info: dict):
 
     def measure(dtype, batch, remat, s2d, conv_impl, loss="milnce",
                 grad_accum=1, timeout_s=None, conv_impl_map=None,
-                mesh=None):
+                mesh=None, impl=None):
         return _run_config(
             timeout_s=timeout_s or cfg_timeout,
             platform_pin=None if on_tpu else "cpu",
@@ -777,7 +805,8 @@ def run_bench(on_tpu: bool, info: dict):
             conv_impl=conv_impl,
             conv_impl_map=impl_map if conv_impl_map is None else conv_impl_map,
             loss=loss, grad_accum=grad_accum,
-            mesh_spec=mesh_spec if mesh is None else mesh, peak=peak,
+            mesh_spec=mesh_spec if mesh is None else mesh,
+            loss_impl=loss_impl if impl is None else impl, peak=peak,
             flops_hint=None if grad_accum > 1
             else hint(dtype, remat, s2d, batch))
 
@@ -882,12 +911,22 @@ def run_bench(on_tpu: bool, info: dict):
             r = measure(**kw)
             _note(f"bench: {r}")
             results.append(r)
-            # comparison rows with a different loss are slower by design
-            # (more work per clip) and must not displace the headline
-            best = max((x for x in results
-                        if x.get("loss", "milnce") == "milnce"
-                        and x.get("grad_accum", 1) == 1),
-                       key=lambda x: x["clips_per_sec_per_chip"])
+            # comparison rows that change the WORK per clip — a
+            # different loss, grad-accum, or the chunked stream's
+            # backward recompute — must not displace the headline: the
+            # vs_baseline anchor is a dense-loss measurement.  Only a
+            # sweep PINNED to chunked (MILNCE_BENCH_LOSS_IMPL=chunked)
+            # lifts the impl filter — it is its own headline population;
+            # an 'auto' sweep resolves per row, and its forced
+            # milnce_chunked comparison row must not slip in on noise.
+            pool = [x for x in results
+                    if x.get("loss", "milnce") == "milnce"
+                    and x.get("grad_accum", 1) == 1
+                    and (loss_impl == "chunked"
+                         or x.get("loss_impl") in (None, "dense"))]
+            if pool:    # empty = every auto row resolved chunked; keep
+                best = max(pool,            # the sweep's own best then
+                           key=lambda x: x["clips_per_sec_per_chip"])
             _emit(_make_record(best, frames, size, on_tpu, kind))
         except Exception as exc:
             dead = tunnel_wedged(exc)
@@ -926,6 +965,18 @@ def run_bench(on_tpu: bool, info: dict):
     if on_tpu and os.environ.get("MILNCE_BENCH_SDTW") != "0":
         extra_row("sdtw_3", loss="sdtw_3", s2d=False, conv_impl="native",
                   conv_impl_map="")
+    # Chunked MIL-NCE row: the streaming loss (losses/milnce_chunked.py)
+    # inside the full compiled step at the winning operating point — the
+    # predicted_peak_bytes_per_chip delta vs the dense sweep rows is the
+    # memory win, step_ms the recompute cost (opt out:
+    # MILNCE_BENCH_MILNCE_CHUNKED=0).  Measured unless the sweep was
+    # ALREADY pinned to chunked via MILNCE_BENCH_LOSS_IMPL=chunked — an
+    # 'auto' sweep still needs it, since at typical bench shapes the
+    # prefers_chunked budget resolves every row to dense.
+    if (on_tpu and loss_impl != "chunked"
+            and os.environ.get("MILNCE_BENCH_MILNCE_CHUNKED") != "0"):
+        extra_row("milnce_chunked", impl="chunked", s2d=False,
+                  conv_impl="native", conv_impl_map="")
     # 2-D mesh row: the FSDP (data, model) grid at the winning operating
     # point — mesh shape + sharding-map hash land in the record so
     # obs_report can diff it against the 1-D rows (opt out:
@@ -989,11 +1040,14 @@ def _write_notes(results, best, kind, on_tpu, n_chips, truncated=False):
             if r.get("cliff_vs_smaller_batch"):
                 clips += (f" (cliff: -{100 * r['cliff_vs_smaller_batch']:.0f}"
                           "% vs smaller batch)")
+            loss_lbl = r.get("loss", "milnce")
+            if r.get("loss_impl") not in (None, "dense"):
+                loss_lbl += f"({r['loss_impl']})"      # streaming MIL-NCE
             lines.append(f"| {r['dtype']} | {r['batch']} | {r['remat']} | "
                          f"{r.get('s2d', False)} | "
                          f"{r.get('conv_impl', 'native')} | "
                          f"{'tuned' if r.get('impl_map') else '-'} | "
-                         f"{r.get('loss', 'milnce')} | "
+                         f"{loss_lbl} | "
                          f"{r.get('grad_accum', 1)} | "
                          f"{r.get('mesh', '-')} | "
                          f"{r['step_ms']} | {clips} | "

@@ -528,6 +528,72 @@ def _e_train_2d(grad_cache: bool = False):
     return build
 
 
+def _e_train_chunked():
+    def build(donate: bool = False):
+        from milnce_tpu.analysis.trace_invariants import (_chunked_loss_cfg,
+                                                          _setup)
+        from milnce_tpu.train.step import make_train_step
+
+        model, opt, mesh, state, batch = _setup()
+        step = make_train_step(model, opt, mesh, donate=donate,
+                               loss_cfg=_chunked_loss_cfg())
+        return step, (state,) + batch()
+    return build
+
+
+# Loss-only entries (ISSUE 12): the dense cube vs the chunked stream at
+# a shape where the LOSS side dominates the plan — b_local=64, Bg=512,
+# K=5, D=16 on the 8-way mesh, so one (B_local, Bg, K) f32 cube is
+# 640 KiB/chip against ~200 KiB of gathered embeddings.  The pins prove
+# the tentpole's scaling claim structurally: dense peaks at the cubes +
+# their AD twins (O(B_local * Bg * K)); chunked peaks at one streamed
+# block (O(B_local * chunk)) — GL013 numbers + the GL015 contributor
+# names say which buffers those are.
+_MILNCE_LOSS_SHAPE = dict(b_global=512, k=5, d=16, chunk=64)
+
+
+def milnce_loss_plan_program(impl: str, b_global: int, k: int, d: int,
+                             chunk: int, backend: str = "scan"):
+    """The ONE sharded value-and-grad loss program both the GL013
+    entries and scripts/milnce_loss_bench.py's memory column plan —
+    shared so the committed BENCH_MILNCE_LOSS.md peaks can never drift
+    from the pinned entries' program.  Returns ``(fn, args)`` for
+    :func:`plan_fn` (args are abstract — nothing allocates)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from milnce_tpu.analysis.trace_invariants import _setup
+    from milnce_tpu.losses.milnce import milnce_loss
+    from milnce_tpu.losses.milnce_chunked import milnce_loss_chunked
+    from milnce_tpu.parallel.compat import shard_map
+
+    _model, _opt, mesh, _state, _batch = _setup()
+
+    def local(v, t):
+        if impl == "chunked":
+            return milnce_loss_chunked(v, t, axis_name="data",
+                                       chunk=chunk, backend=backend)
+        return milnce_loss(v, t, axis_name="data")
+
+    def value_and_grads(v, t):
+        return jax.value_and_grad(local, argnums=(0, 1))(v, t)
+
+    fn = jax.jit(shard_map(
+        value_and_grads, mesh=mesh,
+        in_specs=(P("data"), P("data")),
+        out_specs=(P(), (P("data"), P("data"))), check_vma=False))
+    args = (jax.ShapeDtypeStruct((b_global, d), jnp.float32),
+            jax.ShapeDtypeStruct((b_global * k, d), jnp.float32))
+    return fn, args
+
+
+def _e_milnce_loss(impl: str):
+    def build():
+        return milnce_loss_plan_program(impl, **_MILNCE_LOSS_SHAPE)
+    return build
+
+
 @functools.lru_cache(maxsize=1)
 def _serve_engine():
     """Cold engine (precompile=False — planning only needs the traced
@@ -639,6 +705,12 @@ def _entries() -> dict:
                  donate_argnums=DON, grad_bearing=True),
         MemEntry("grad_cache_step_milnce", _e_grad_cache(),
                  donate_argnums=DON, grad_bearing=True),
+        MemEntry("train_step_milnce_chunked", _e_train_chunked(),
+                 donate_argnums=DON, grad_bearing=True),
+        MemEntry("milnce_loss_dense", _e_milnce_loss("dense"),
+                 argnames=("video", "text")),
+        MemEntry("milnce_loss_chunked", _e_milnce_loss("chunked"),
+                 argnames=("video", "text")),
         MemEntry("train_step_milnce_2d", _e_train_2d(),
                  donate_argnums=DON, grad_bearing=True,
                  mesh="4x2 (data,model)"),
@@ -672,6 +744,21 @@ EXPECTED_PEAK_BYTES = {
     "train_step_milnce_guarded": 16917340,
     "train_step_sdtw3": 10612424,
     "grad_cache_step_milnce": 12448688,
+    # chunked MIL-NCE (ISSUE 12): the full chunked step pins IDENTICAL
+    # to train_step_milnce — at the tiny entry scale the optimizer
+    # moments dominate both, which is itself the no-regression pin (the
+    # stream must never ADD memory).  The loss-only pair below isolates
+    # the loss side at a shape where the cube dominates: dense peaks at
+    # the (B_local, Bg, K) cubes + AD twins (the GL015 names are the
+    # [64, 2560] = (B_local, Bg*K) cube ops), chunked at one
+    # (B_local, chunk*K) streamed block — O(B_local*Bg*K) ->
+    # O(B_local*chunk), 4.1x less per chip at this shape, and the gap
+    # widens linearly in Bg/chunk (tests/test_memplan.py pins the
+    # strict inequality; PERF.md "Memory-efficient loss" has the
+    # Bg=8192 what-if numbers).
+    "train_step_milnce_chunked": 10612424,
+    "milnce_loss_dense": 2863940,
+    "milnce_loss_chunked": 703276,
     "train_step_milnce_2d": 8652104,
     "grad_cache_2d": 11399984,
     "serve_text_embed@b0": 2119092,
@@ -711,6 +798,23 @@ EXPECTED_TOP_CONTRIBUTORS = {
         "state/opt_state/inner_state/inner_state/0/mu/conv_2c/conv_spatial/kernel",
         "state/opt_state/inner_state/inner_state/0/mu/conv_2c/conv_temporal/kernel",
         "state/opt_state/inner_state/inner_state/0/mu/mixed_3b/conv_b1_b/conv_spatial/kernel"),
+    "train_step_milnce_chunked": (
+        "state/opt_state/inner_state/inner_state/0/mu/conv_2c/conv_spatial/kernel",
+        "state/opt_state/inner_state/inner_state/0/mu/conv_2c/conv_temporal/kernel",
+        "state/opt_state/inner_state/inner_state/0/mu/mixed_3b/conv_b1_b/conv_spatial/kernel"),
+    # the loss-only pair: dense's top contributors ARE the similarity
+    # cube ([64, 2560] = (B_local, Bg*K) softmax intermediates + the
+    # lse-transpose scatter over the (B_local, Bg, K) cube); chunked's
+    # are one (B_local, chunk*K) = [64, 320] streamed block — the
+    # tentpole's scaling claim, pinned by name
+    "milnce_loss_dense": (
+        "exp float32[64,2560]",
+        "broadcast_in_dim float32[64,2560]",
+        "scatter-add float32[64,512,5]"),
+    "milnce_loss_chunked": (
+        "exp float32[64,320]",
+        "reshape float32[8,320,16]",
+        "broadcast_in_dim float32[64,320]"),
     "train_step_milnce_2d": (
         "state/opt_state/inner_state/inner_state/0/mu/conv_2c/conv_spatial/kernel",
         "state/opt_state/inner_state/inner_state/0/mu/conv_2c/conv_temporal/kernel",
@@ -970,7 +1074,8 @@ def run_memplan_checks(entries=None, plans=None) -> list:
 def what_if_step(*, batch: int, frames: int, size: int, words: int = 20,
                  k: int = 5, dtype: str = "bfloat16", grad_accum: int = 1,
                  mesh_axes=None, preset: str = "full",
-                 fsdp_min_size=None) -> MemPlan:
+                 fsdp_min_size=None, loss_impl: str = "dense",
+                 milnce_chunk: int = 0) -> MemPlan:
     """Predict the per-chip peak of the train step at a (possibly TPU-
     scale) operating point from a CPU trace: the model is built at the
     requested config, the state comes from ``jax.eval_shape`` (no bytes
@@ -994,6 +1099,17 @@ def what_if_step(*, batch: int, frames: int, size: int, words: int = 20,
 
     cfg = full_preset() if preset == "full" else tiny_preset()
     cfg.model.dtype = dtype
+    # loss-impl axis (ISSUE 12): predict the SAME operating point under
+    # the dense cube vs the chunked stream — the dense-vs-chunked
+    # crossover at the Bg=8192 recipe is a what-if verdict pair, no chip
+    # needed (PERF.md "Memory-efficient loss", BENCH_MILNCE_LOSS.md)
+    cfg.loss.milnce_impl = loss_impl
+    cfg.loss.milnce_chunk = int(milnce_chunk)
+    if loss_impl == "dense" and milnce_chunk:
+        raise ValueError(
+            "--milnce-chunk only shapes the chunked/auto impls — pass "
+            "--loss-impl chunked (a dense what-if never reads it)")
+    loss_cfg = cfg.loss if loss_impl != "dense" else None
     mesh_axes = dict(mesh_axes or {"data": len(jax.devices())})
     model_axis = None
     for ax, n in mesh_axes.items():
@@ -1035,11 +1151,12 @@ def what_if_step(*, batch: int, frames: int, size: int, words: int = 20,
         state_specs = state_partition_specs(state, mesh, model_axis, **kw)
     if grad_accum > 1:
         step = make_grad_cache_step(model, optimizer, mesh, grad_accum,
-                                    donate=False, state_specs=state_specs,
+                                    donate=False, loss_cfg=loss_cfg,
+                                    state_specs=state_specs,
                                     model_axis=model_axis)
     else:
         step = make_train_step(model, optimizer, mesh, donate=False,
-                               state_specs=state_specs,
+                               loss_cfg=loss_cfg, state_specs=state_specs,
                                model_axis=model_axis)
     args = (state,
             jax.ShapeDtypeStruct((batch, frames, size, size, 3), jnp.uint8),
@@ -1047,10 +1164,11 @@ def what_if_step(*, batch: int, frames: int, size: int, words: int = 20,
             jax.ShapeDtypeStruct((batch,), jnp.float32))
     mesh_desc = "x".join(f"{n}" for n in mesh_axes.values()) + (
         f" ({','.join(mesh_axes)})")
+    impl_tag = "" if loss_impl == "dense" else f", loss={loss_impl}"
     return plan_fn(step, args, argnames=_STEP_ARGNAMES,
                    donate_argnums=STATE_DONATION_ARGNUMS,
                    entry=f"what_if(batch={batch}, {frames}f@{size}, "
-                         f"{dtype}, ga={grad_accum})",
+                         f"{dtype}, ga={grad_accum}{impl_tag})",
                    mesh=mesh_desc)
 
 

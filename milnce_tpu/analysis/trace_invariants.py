@@ -70,6 +70,18 @@ EXPECTED_COLLECTIVES = {
     # and the entry also EXECUTES it under transfer_guard("disallow")
     "train_step_milnce_instrumented": {"all_gather": 2, "psum": 26,
                                        "reduce_scatter": 2},
+    # chunked MIL-NCE (ISSUE 12): the streaming loss must keep the DENSE
+    # step's exact communication structure — the same 2 negative
+    # all_gathers (whose AD transposes stay the same 2 reduce_scatters)
+    # and the same psum census; the chunk scan adds compute structure,
+    # never collectives (its body is pinned collective-free by the
+    # scan-reduction-free check on these entries).  The pins being
+    # IDENTICAL to train_step_milnce / train_step_milnce_2d is the
+    # invariant, exactly like the guarded/instrumented twins above.
+    "train_step_milnce_chunked": {"all_gather": 2, "psum": 26,
+                                  "reduce_scatter": 2},
+    "train_step_milnce_chunked_2d": {"all_gather": 22, "psum": 78,
+                                     "reduce_scatter": 22},
     "train_step_sdtw3": {"all_gather": 3, "psum": 25,
                          "reduce_scatter": 2},
     "grad_cache_step_milnce": {"all_gather": 2, "psum": 26,
@@ -450,6 +462,121 @@ def _entry_grad_cache_2d() -> list[CheckResult]:
                          scan_reduction_free=True)
 
 
+def _chunked_loss_cfg():
+    """The chunked-step entries' LossConfig: scan backend (the pinned
+    program must not depend on the host platform) and chunk=6 on the
+    16-clip entry batch — 3 chunks with a masked uneven tail, so the
+    pinned program exercises the Bg % chunk != 0 path."""
+    from milnce_tpu.config import LossConfig
+
+    return LossConfig(name="milnce", milnce_impl="chunked",
+                      milnce_chunk=6, milnce_backend="scan")
+
+
+def _entry_train_step_milnce_chunked() -> list[CheckResult]:
+    """ISSUE 12 tentpole pins: the chunked streaming MIL-NCE step keeps
+    the dense step's collective multiset (2 gathers / 2 reduce_scatter
+    transposes / same psums), its chunk scan is collective-free, and
+    the double-call recompile check holds."""
+    from milnce_tpu.train.step import make_train_step
+
+    model, opt, mesh, state, batch = _setup()
+    step = make_train_step(model, opt, mesh, donate=False,
+                           loss_cfg=_chunked_loss_cfg())
+    name = "train_step_milnce_chunked"
+    out = _jaxpr_checks(name, step, (state,) + batch(),
+                        scan_reduction_free=True)
+    same = (EXPECTED_COLLECTIVES[name]
+            == EXPECTED_COLLECTIVES["train_step_milnce"])
+    out.append(CheckResult(
+        name, "identical-to-dense", same,
+        "" if same else "pins diverged — the chunked and dense steps "
+        "must share one communication structure (the stream changes "
+        "memory, never collectives)"))
+    out.append(_recompile_check(name, step,
+                                lambda s: (state,) + batch(s)))
+    return out
+
+
+def _entry_train_step_milnce_chunked_2d() -> list[CheckResult]:
+    """The 4x2 FSDP twin: chunked loss under the 2-D step keeps the 2-D
+    dense pins (gather/reduce-scatter pairs + per-leaf psums) with a
+    collective-free chunk scan."""
+    from milnce_tpu.train.step import make_train_step
+
+    model, opt, mesh, specs, state, batch = _setup_2d()
+    step = make_train_step(model, opt, mesh, donate=False,
+                           loss_cfg=_chunked_loss_cfg(),
+                           state_specs=specs, model_axis="model")
+    name = "train_step_milnce_chunked_2d"
+    out = _jaxpr_checks(name, step, (state,) + batch(),
+                        scan_reduction_free=True)
+    same = (EXPECTED_COLLECTIVES[name]
+            == EXPECTED_COLLECTIVES["train_step_milnce_2d"])
+    out.append(CheckResult(
+        name, "identical-to-dense", same,
+        "" if same else "pins diverged — the chunked and dense 2-D "
+        "steps must share one communication structure"))
+    out.append(_recompile_check(name, step,
+                                lambda s: (state,) + batch(s)))
+    return out
+
+
+def _entry_milnce_chunked_dispatch() -> list[CheckResult]:
+    """ISSUE 12 acceptance: ``milnce_loss_chunked(backend='auto')``
+    keeps a stable compiled path across its shape-dispatch rule — the
+    probed shapes straddle ``milnce_pallas.prefers_pallas`` (one fused-
+    kernel shape, one scan shape) and a second same-shape call of the
+    jitted value-and-grad must hit the jit cache (the sdtw_pallas_
+    dispatch gate discipline)."""
+    import jax
+    import numpy as np
+
+    from milnce_tpu.losses.milnce_chunked import (milnce_default_chunk,
+                                                  milnce_loss_chunked)
+    from milnce_tpu.ops.milnce_pallas import prefers_pallas
+
+    name = "milnce_chunked_dispatch"
+    fn = jax.jit(jax.value_and_grad(
+        lambda v, t: milnce_loss_chunked(v, t, backend="auto"),
+        argnums=(0, 1)))
+    # (B, K, D): one shape where the auto rule picks the fused kernel
+    # (lane-aligned D, VMEM-resident blocks), one where it picks the
+    # scan (D off the lane grid) — re-derive with prefers_pallas if the
+    # rule moves
+    shapes = [(8, 2, 128), (8, 2, 16)]
+    sides = set()
+    for b, k, d in shapes:
+        chunk = milnce_default_chunk(b, k, b)
+        sides.add(prefers_pallas(b, b, k, d, chunk))
+    out = [CheckResult(
+        name, "dispatch-coverage", sides == {True, False},
+        "" if sides == {True, False} else
+        f"probe shapes no longer straddle the auto rule ({sides}) — "
+        "re-pick shapes so both backends stay gated")]
+
+    def args(b, k, d, seed):
+        r = np.random.default_rng(seed)
+        return (r.standard_normal((b, d)).astype(np.float32),
+                r.standard_normal((b * k, d)).astype(np.float32))
+
+    if not hasattr(fn, "_cache_size"):
+        out.append(CheckResult(name, "recompile", True,
+                               "skipped: no _cache_size on this jax"))
+        return out
+    for b, k, d in shapes:
+        fn(*args(b, k, d, 0))
+        fn(*args(b, k, d, 1))
+    n_entries = fn._cache_size()
+    out.append(CheckResult(
+        name, "recompile", n_entries == len(shapes),
+        "" if n_entries == len(shapes) else
+        f"{n_entries} jit-cache entries for {len(shapes)} dispatch "
+        "shapes called twice each — the auto backend retraces per call "
+        "(unstable dispatch input)"))
+    return out
+
+
 def _entry_sdtw_pallas_dispatch() -> list[CheckResult]:
     """ROADMAP item 1 loose end: ``SoftDTW(backend='auto')`` must keep a
     STABLE compiled path across its shape-dispatch rule — one jit-cache
@@ -731,6 +858,9 @@ ENTRY_POINTS = {
     "grad_cache_step_milnce": _entry_grad_cache_step,
     "train_step_milnce_2d": _entry_train_step_2d,
     "grad_cache_2d": _entry_grad_cache_2d,
+    "train_step_milnce_chunked": _entry_train_step_milnce_chunked,
+    "train_step_milnce_chunked_2d": _entry_train_step_milnce_chunked_2d,
+    "milnce_chunked_dispatch": _entry_milnce_chunked_dispatch,
     "sdtw_pallas_dispatch": _entry_sdtw_pallas_dispatch,
     "retrieval_embed": _entry_retrieval_embed,
     "softdtw_scan": _entry_softdtw_scan,

@@ -143,6 +143,29 @@ class LossConfig:
     """Loss selection + hyperparams (reference: loss.py)."""
 
     name: str = "milnce"                # milnce | cdtw | sdtw_cidm | sdtw_negative | sdtw_3
+    milnce_impl: str = "dense"          # dense | chunked | auto: 'dense'
+                                        # materializes the two
+                                        # (B_local, Bg, K) similarity cubes
+                                        # (losses/milnce.py — fewest matmul
+                                        # passes, fine while the cubes are
+                                        # small); 'chunked' streams negative
+                                        # chunks with running logsumexps and
+                                        # a recompute-in-backward custom VJP
+                                        # (losses/milnce_chunked.py — the
+                                        # Bg=8192 recipe's loss); 'auto'
+                                        # switches to chunked once the cubes
+                                        # + AD twins pass the 64 MiB budget
+                                        # (prefers_chunked).  PERF.md
+                                        # "Memory-efficient loss".
+    milnce_chunk: int = 0               # global samples per streamed chunk
+                                        # (0 = the milnce_default_chunk
+                                        # rule, ~2 MiB of row logits per
+                                        # block); Bg % chunk != 0 is padded
+                                        # + masked
+    milnce_backend: str = "auto"        # chunked impl inner backend: auto |
+                                        # scan | pallas (auto = the
+                                        # prefers_pallas VMEM/lane shape
+                                        # rule, ops/milnce_pallas.py)
     sdtw_backend: str = "auto"          # auto | scan | pallas; auto picks the
                                         # TPU wavefront kernel wherever a
                                         # measured-winning layout applies
@@ -161,6 +184,13 @@ class LossConfig:
                                         # cosine | negative_dot |
                                         # negative_cosine | euclidean
     sdtw_bandwidth: int = 0             # Sakoe-Chiba band; 0 = off
+    sdtw_pair_chunk: int = 0            # sdtw_3 only: stream each NCE
+                                        # term's B x B pair logsumexp in
+                                        # anchor-row chunks of this size
+                                        # (jax.checkpoint'd scan — peak
+                                        # pair batch O(B*chunk) instead
+                                        # of the B^2 broadcast); 0 = the
+                                        # dense all-pairs form
     cidm_sigma: float = 10.0            # loss.py:58
     cidm_lambda: float = 1.0            # loss.py:57
 

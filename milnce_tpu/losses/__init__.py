@@ -1,3 +1,5 @@
 from milnce_tpu.losses.milnce import milnce_loss  # noqa: F401
+from milnce_tpu.losses.milnce_chunked import (  # noqa: F401
+    build_milnce_loss, milnce_loss_chunked)
 from milnce_tpu.losses.dtw_losses import (  # noqa: F401
     cdtw_loss, sdtw_3_loss, sdtw_cidm_loss, sdtw_negative_loss)

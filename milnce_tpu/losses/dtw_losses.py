@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from milnce_tpu.ops.softdtw import SoftDTW, _cosine_sim
 
@@ -149,17 +150,67 @@ def _all_pairs_sdtw(a: jax.Array, b_seq: jax.Array, sdtw: SoftDTW) -> jax.Array:
     return sdtw(rows, cols).reshape(b, b)
 
 
+def _all_pairs_sdtw_lse(a: jax.Array, b_seq: jax.Array, sdtw: SoftDTW,
+                        pair_chunk: int) -> jax.Array:
+    """``logsumexp_j(-sdtw(a_j, b_i))`` per row i of ``b_seq`` WITHOUT
+    the B x B pair batch: the same streaming-logsumexp treatment the
+    chunked MIL-NCE applies to its similarity cube
+    (losses/milnce_chunked.py), pure-jax only.
+
+    ``_all_pairs_sdtw`` broadcasts both sequences to a B^2 pair batch,
+    so its DP runs (and AD saves) B^2 tables at once — the worst small
+    offender of the loss family.  Here chunks of ``pair_chunk`` ``a``
+    rows are scored per ``lax.scan`` step (a (B * pair_chunk) pair
+    batch) into per-chunk partial logsumexps, combined at the end; the
+    body runs under ``jax.checkpoint`` so the backward RECOMPUTES each
+    chunk's DP instead of keeping B^2 saved tables.  Peak pair-batch
+    memory drops from O(B^2) to O(B * pair_chunk); parity (value and
+    grad) vs the dense form is pinned in tests/test_dtw_losses.py."""
+    from milnce_tpu.ops.softdtw import BIG
+
+    b = a.shape[0]
+    nc = -(-b // pair_chunk)
+    pad = nc * pair_chunk - b
+    a_pad = jnp.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1))
+    a_ch = a_pad.reshape((nc, pair_chunk) + a.shape[1:])
+    starts = jnp.arange(nc, dtype=jnp.int32) * pair_chunk
+
+    def body(carry, xs):
+        a_c, start = xs
+        rows = jnp.broadcast_to(a_c[None], (b,) + a_c.shape)
+        rows = rows.reshape((-1,) + a_c.shape[1:])
+        cols = jnp.broadcast_to(b_seq[:, None],
+                                (b, pair_chunk) + b_seq.shape[1:])
+        cols = cols.reshape((-1,) + b_seq.shape[1:])
+        vals = -sdtw(rows, cols).reshape(b, pair_chunk)
+        ok = (start + jnp.arange(pair_chunk)) < b      # pad rows -> -BIG
+        vals = jnp.where(ok[None, :], vals, -BIG)
+        return carry, jax.nn.logsumexp(vals, axis=1)
+
+    _, parts = lax.scan(jax.checkpoint(body), None, (a_ch, starts))
+    return jax.nn.logsumexp(parts, axis=0)             # (nc, B) -> (B,)
+
+
 def sdtw_3_loss(video_seq: jax.Array, text_seq: jax.Array, gamma: float = 0.1,
                 backend: str = "scan", dist: str = "",
-                bandwidth: int = 0) -> tuple[jax.Array, jax.Array, jax.Array]:
+                bandwidth: int = 0, pair_chunk: int = 0
+                ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Three NCE-over-soft-DTW terms — video<->video, video<->text,
-    text<->text (reference SDTW_3, loss.py:93-134), negative-dot distance."""
+    text<->text (reference SDTW_3, loss.py:93-134), negative-dot distance.
+
+    ``pair_chunk > 0`` streams each term's negative logsumexp over
+    chunks of that many anchor rows (:func:`_all_pairs_sdtw_lse`)
+    instead of materializing the full B x B pair batch; 0 keeps the
+    dense all-pairs form (and the pinned ``train_step_sdtw3`` trace)."""
     sdtw = SoftDTW(gamma=gamma, dist_func=dist or "negative_dot",
                    bandwidth=bandwidth, backend=backend)
 
     def nce(x, y):
         pos = -sdtw(x, y)
-        neg = jax.nn.logsumexp(-_all_pairs_sdtw(x, y, sdtw), axis=1)
+        if 0 < pair_chunk < x.shape[0]:
+            neg = _all_pairs_sdtw_lse(x, y, sdtw, pair_chunk)
+        else:
+            neg = jax.nn.logsumexp(-_all_pairs_sdtw(x, y, sdtw), axis=1)
         return jnp.mean(neg - pos)
 
     return (nce(video_seq, video_seq), nce(video_seq, text_seq),

@@ -24,8 +24,31 @@ two (B_local, Bg, K) f32 cubes = 2 x 128*8192*5*4 B ~ 42 MB per chip
 transpose concat, loss.py:16).  The denominator combines two separate
 logsumexp reductions with logaddexp, so no (B, 2*Bg*K) concat is ever
 materialized; tests/test_milnce.py pins the compiled per-chip temp size
-at Bg=8192.  A reduce_scatter formulation could stream the cols cube too,
-but at these scales the gather+local-score form is already HBM-trivial.
+at Bg=8192.
+
+The cubes are NOT free, though — an earlier revision of this docstring
+called the gather+local-score form "already HBM-trivial", which the
+PR 8 static planner disproved once AD residuals are counted: reverse
+mode saves both cubes (and their softmax intermediates) for the
+backward, so the loss side really holds ~4 cubes plus the lse-transpose
+scatter.  Measured by the GL013 memplan pins (analysis/memplan.py, the
+``milnce_loss_dense`` / ``milnce_loss_chunked`` entries at B_local=64,
+Bg=512, K=5, D=16): this dense form peaks at 2,863,940 B/chip with the
+(B_local, Bg*K) cube ops as the named top contributors, vs 703,276
+B/chip for the chunked stream — O(B_local * Bg * K) vs
+O(B_local * chunk), a gap that grows linearly in Bg/chunk.  At the
+Bg=8192 what-if (``mem_plan --what-if --batch 8192 --mesh data=64``),
+the loss side (gathered-text transpose + cube matmul) becomes the
+step's top per-chip contributor as soon as the video/text towers stop
+dominating (grad-accum recipe, low-res curriculum stages, larger K) —
+dense 1.046 GiB/chip vs chunked 0.791 GiB/chip at the 8f@64 K=32 point
+(BENCH_MILNCE_LOSS.md has the full table).
+
+When the cubes matter, use ``losses/milnce_chunked.py``
+(``loss.milnce_impl = chunked | auto``): identical semantics and
+collective structure, with the cube streamed through running
+logsumexp accumulators and recomputed chunk-by-chunk in the backward
+(scan form, plus a fused Pallas kernel in ops/milnce_pallas.py).
 """
 
 from __future__ import annotations

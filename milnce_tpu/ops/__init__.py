@@ -2,5 +2,8 @@ from milnce_tpu.ops.softdtw import SoftDTW, softdtw_scan  # noqa: F401
 from milnce_tpu.ops import softdtw_pallas  # noqa: F401  (submodule; its
 # main entry point is softdtw_pallas.softdtw_pallas — re-exporting the
 # function here would shadow the submodule attribute)
+from milnce_tpu.ops import milnce_pallas  # noqa: F401  (submodule; its
+# entry point is milnce_pallas.milnce_stream_pallas — the chunked
+# MIL-NCE stream's fused kernel)
 from milnce_tpu.ops.dtw import dtw_loss  # noqa: F401
 from milnce_tpu.ops.softdtw_sp import softdtw_seq_parallel  # noqa: F401

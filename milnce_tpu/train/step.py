@@ -47,7 +47,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from milnce_tpu.losses.milnce import milnce_loss
+from milnce_tpu.losses.milnce_chunked import build_milnce_loss
 from milnce_tpu.parallel.compat import donation_argnums, shard_map
 from milnce_tpu.resilience import faults
 from milnce_tpu.train.state import TrainState
@@ -198,7 +198,9 @@ def _sequence_loss(loss_cfg, v_seq, t_seq, start, data_axis):
             v_all, t_all, start_all, sigma=loss_cfg.cidm_sigma,
             lam=loss_cfg.cidm_lambda, **common),
         "sdtw_negative": lambda: sdtw_negative_loss(v_all, t_all, **common),
-        "sdtw_3": lambda: sum(sdtw_3_loss(v_all, t_all, **common)),
+        "sdtw_3": lambda: sum(sdtw_3_loss(
+            v_all, t_all,
+            pair_chunk=getattr(loss_cfg, "sdtw_pair_chunk", 0), **common)),
     }
     # one source of truth: a loss added here without a KNOWN_LOSSES entry
     # (or vice versa) fails loudly at first trace, not per-name
@@ -273,6 +275,10 @@ def make_grad_cache_step(model, optimizer, mesh: Mesh,
     """
     assert micro_batches > 1, "use make_train_step for micro_batches=1"
     loss_name = _check_loss_name(loss_cfg)
+    # impl selection (dense cube / chunked stream / auto) resolves at
+    # BUILD time from LossConfig; 'dense' (and loss_cfg=None) keeps the
+    # traced program byte-identical to the pre-chunked step
+    milnce_fn = build_milnce_loss(loss_cfg) if loss_name == "milnce" else None
     mesh_size = _check_2d_args(mesh, data_axis, model_axis, state_specs)
     fsdp = model_axis is not None
     batch_axes = (data_axis, model_axis) if fsdp else data_axis
@@ -315,7 +321,7 @@ def make_grad_cache_step(model, optimizer, mesh: Mesh,
         # negatives/pairs exactly as the single-pass step)
         if loss_name == "milnce":
             def loss_of(v, t):
-                return milnce_loss(v, t, axis_name=batch_axes)
+                return milnce_fn(v, t, batch_axes)
         else:
             def loss_of(v, t):
                 t_seq = t.reshape(b, -1, t.shape[-1])      # (B, K, D)
@@ -441,6 +447,7 @@ def make_train_step(model, optimizer, mesh: Mesh, data_axis: str = "data",
     never move.
     """
     loss_name = _check_loss_name(loss_cfg)
+    milnce_fn = build_milnce_loss(loss_cfg) if loss_name == "milnce" else None
     mesh_size = _check_2d_args(mesh, data_axis, model_axis, state_specs)
     fsdp = model_axis is not None
     # the loss axes: on the 2-D mesh every chip is a data shard (the
@@ -465,7 +472,7 @@ def make_train_step(model, optimizer, mesh: Mesh, data_axis: str = "data",
                 (v_embd, t_embd), mutated = model.apply(
                     variables, video, text_ids, train=True,
                     mutable=["batch_stats"])
-                loss = milnce_loss(v_embd, t_embd, axis_name=batch_axes)
+                loss = milnce_fn(v_embd, t_embd, batch_axes)
             else:
                 (v_seq, t_embd), mutated = model.apply(
                     variables, video, text_ids, mode="sequence", train=True,

@@ -136,6 +136,14 @@ def main(argv=None) -> int:
     ap.add_argument("--k", type=int, default=5)
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--grad-accum", type=int, default=1)
+    ap.add_argument("--loss-impl", default="dense",
+                    choices=["dense", "chunked", "auto"],
+                    help="MIL-NCE impl for --what-if (loss.milnce_impl): "
+                         "predict the same operating point under the "
+                         "dense cube vs the chunked stream")
+    ap.add_argument("--milnce-chunk", type=int, default=0,
+                    help="chunked-impl streamed block size (0 = the "
+                         "milnce_default_chunk rule)")
     ap.add_argument("--mesh", default="",
                     help="'data=4,model=2' (what-if; '' = 8-way data)")
     ap.add_argument("--hbm-gib", type=float, default=16.0,
@@ -164,7 +172,8 @@ def main(argv=None) -> int:
             batch=args.batch, frames=args.frames, size=args.size,
             words=args.words, k=args.k, dtype=args.dtype,
             grad_accum=args.grad_accum, mesh_axes=mesh_axes,
-            preset=args.preset)
+            preset=args.preset, loss_impl=args.loss_impl,
+            milnce_chunk=args.milnce_chunk)
         fits, msg = memplan.budget_verdict(plan, args.hbm_gib)
         print(msg)
         return 0 if fits else 1

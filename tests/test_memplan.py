@@ -313,6 +313,10 @@ def test_all_registered_entries_plan_green():
     assert {"train_step_milnce", "train_step_milnce_guarded",
             "train_step_sdtw3", "grad_cache_step_milnce",
             "train_step_milnce_2d", "grad_cache_2d",
+            # ISSUE 12: the chunked step + the loss-only pair isolating
+            # the O(B_local*Bg*K) -> O(B_local*chunk) claim
+            "train_step_milnce_chunked", "milnce_loss_dense",
+            "milnce_loss_chunked",
             "serve_text_embed@b0", "serve_text_embed@b1",
             "serve_video_embed@b0", "serve_video_embed@b1",
             "serve_index_topk",
@@ -336,6 +340,56 @@ def test_guarded_step_peak_exceeds_plain_by_one_state_copy():
     plain = memplan.EXPECTED_PEAK_BYTES["train_step_milnce"]
     guarded = memplan.EXPECTED_PEAK_BYTES["train_step_milnce_guarded"]
     assert guarded > plain * 1.2
+
+
+def test_milnce_chunked_loss_peak_strictly_below_dense():
+    """The ISSUE 12 acceptance pin, stated on the pins themselves: at
+    the loss-only entry shape (B_local=64, Bg=512, K=5) the chunked
+    stream's per-chip peak is strictly — and substantially — below the
+    dense cube's, and the chunked step never exceeds the dense step.
+    The GL015 names behind the numbers: dense peaks at the
+    (B_local, Bg*K) cube intermediates, chunked at one
+    (B_local, chunk*K) streamed block (analysis/memplan.py)."""
+    e = memplan.EXPECTED_PEAK_BYTES
+    assert e["milnce_loss_chunked"] < e["milnce_loss_dense"]
+    # the gap is structural (Bg/chunk = 8 at this shape), not noise
+    assert e["milnce_loss_chunked"] < 0.5 * e["milnce_loss_dense"]
+    assert e["train_step_milnce_chunked"] <= e["train_step_milnce"]
+    # and the planned (not just pinned) values agree with the claim
+    plans = memplan.plan_all(["milnce_loss_dense", "milnce_loss_chunked"])
+    assert (plans["milnce_loss_chunked"].peak_bytes
+            < plans["milnce_loss_dense"].peak_bytes)
+
+
+def test_what_if_loss_impl_axis_reaches_the_traced_program(monkeypatch):
+    """--loss-impl / --milnce-chunk must reach the step FACTORY (a
+    config-only dead knob here would quietly un-gate the 8192 crossover
+    table in BENCH_MILNCE_LOSS.md): a spy on make_train_step captures
+    the loss_cfg the what-if actually builds with — one trace instead
+    of a dense/chunked plan pair (the strictly-below direction is
+    already pinned by the milnce_loss_* entries; the Bg=8192 pair lives
+    in the committed table).  A --milnce-chunk without a chunked impl
+    is refused outright."""
+    import milnce_tpu.train.step as step_mod
+
+    with pytest.raises(ValueError, match="milnce-chunk"):
+        memplan.what_if_step(batch=16, frames=4, size=32, words=6, k=3,
+                             preset="tiny", milnce_chunk=8)
+    seen = {}
+    real = step_mod.make_train_step
+
+    def spy(*args, **kwargs):
+        seen["loss_cfg"] = kwargs.get("loss_cfg")
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(step_mod, "make_train_step", spy)
+    plan = memplan.what_if_step(batch=64, frames=4, size=32, words=6,
+                                k=3, dtype="float32", preset="tiny",
+                                loss_impl="chunked", milnce_chunk=8)
+    assert "loss=chunked" in plan.entry
+    assert seen["loss_cfg"] is not None
+    assert seen["loss_cfg"].milnce_impl == "chunked"
+    assert seen["loss_cfg"].milnce_chunk == 8
 
 
 def test_2d_entries_plan_below_their_1d_twins():

@@ -26,8 +26,8 @@ _TESTS = os.path.join(_REPO, "tests")
 # child is listed so the audit SEES it; its one caller is then an
 # explicit, reasoned exemption below rather than an invisible spawn.
 _EXPENSIVE_FRAGMENTS = ("bench.py", "stage_probe.py", "xla_flag_probe.py",
-                        "real_train_eval.py", "._run_config(",
-                        "lockrt_hammer_child.py")
+                        "milnce_loss_bench.py", "real_train_eval.py",
+                        "._run_config(", "lockrt_hammer_child.py")
 
 # audited exceptions: child-process tests that are seconds-scale by
 # construction and REQUIRED tier-1 by their ISSUE (a fresh interpreter +
@@ -113,6 +113,7 @@ _REPORT_GENERATORS = {
     "DATA_BENCH.md": "scripts/data_bench.py",
     "LINT.md": "scripts/graft_lint.py",
     "MEMPLAN.md": "scripts/mem_plan.py",
+    "BENCH_MILNCE_LOSS.md": "scripts/milnce_loss_bench.py",
 }
 
 
@@ -148,6 +149,8 @@ def test_report_writers_emit_generator_headers():
             "auto-written by scripts/graft_lint.py",
         os.path.join(_REPO, "scripts", "mem_plan.py"):
             "auto-written by scripts/mem_plan.py",
+        os.path.join(_REPO, "scripts", "milnce_loss_bench.py"):
+            "auto-written by scripts/milnce_loss_bench.py",
     }
     for path, header in writers.items():
         assert header in open(path).read(), (
@@ -294,6 +297,29 @@ def test_mesh2d_gates_exist_and_stay_tier1():
         assert not slow, (
             "2-D mesh tests must be tier-1/CPU-safe, never @slow (they "
             f"are the pod-scale-layout regression fence): {fname}::{slow}")
+
+
+# memory-efficient loss gates (ISSUE 12): the chunked MIL-NCE parity
+# suite — dense-vs-chunked value/grad parity across backends and mesh
+# layouts, plus the 2-optimizer-step train parity pins — is the
+# regression fence for the streaming loss path.  Same rule as every
+# other subsystem gate: tier-1, never @slow, never vanished.
+_MEMLOSS_GATES = ("test_milnce_chunked.py",)
+
+
+def test_memloss_gates_exist_and_stay_tier1():
+    for fname in _MEMLOSS_GATES:
+        path = os.path.join(_TESTS, fname)
+        assert os.path.exists(path), f"mem-loss gate {fname} is missing"
+        src = open(path).read()
+        tests = list(_iter_tests(ast.parse(src)))
+        assert tests, f"{fname} defines no tests"
+        slow = [node.name for node, class_slow in tests
+                if _is_slow_marked(node, class_slow)]
+        assert not slow, (
+            "chunked MIL-NCE tests must be tier-1/CPU-safe, never @slow "
+            "(they are the memory-efficient-loss regression fence): "
+            f"{fname}::{slow}")
 
 
 def test_fast_child_exemptions_stay_real():

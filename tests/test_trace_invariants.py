@@ -33,6 +33,11 @@ def test_all_registered_entry_invariants_hold():
             "train_step_milnce_instrumented", "train_step_sdtw3",
             "grad_cache_step_milnce", "video_embed", "text_embed",
             "softdtw_scan_grad", "param_treedef",
+            # ISSUE 12: chunked streaming MIL-NCE — dense-identical
+            # collective pins, collective-free chunk scans, and the
+            # backend-dispatch no-recompile gate
+            "train_step_milnce_chunked", "train_step_milnce_chunked_2d",
+            "milnce_chunked_dispatch",
             "serve_embed_ladder", "serve_text_embed", "serve_video_embed",
             "serve_index_topk",
             # ISSUE 10: pooled serving — per-replica ladder recompile pin
