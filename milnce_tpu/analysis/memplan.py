@@ -693,6 +693,34 @@ def _e_index_topk():
     return build
 
 
+def _e_live_index_topk():
+    def build():
+        import jax
+        import numpy as np
+
+        from milnce_tpu.analysis.trace_invariants import _TINY, _setup
+        from milnce_tpu.serving.live_index import LiveRetrievalIndex
+
+        _model, _opt, mesh, _state, _batch = _setup()
+        ndev = len(jax.devices())
+        rng = np.random.default_rng(0)
+        corpus = rng.standard_normal(
+            (3 * ndev - 2, _TINY["embedding_dim"])).astype(np.float32)
+        # same boot corpus as serve_index_topk, but the LIVE index pads
+        # every shard to its capacity RUNG (power of two >= k) — the
+        # footprint the planner prices is the rung's, i.e. what a
+        # generation costs for the whole life of that rung
+        index = LiveRetrievalIndex(mesh, corpus, k=3, query_buckets=(ndev,),
+                                   precompile=False)
+        try:
+            q = rng.standard_normal((ndev, index.dim)).astype(np.float32)
+            fn, operands = index.topk_program()
+            return fn, operands + (q,)
+        finally:
+            index.close()
+    return build
+
+
 def _entries() -> dict:
     from milnce_tpu.train.step import STATE_DONATION_ARGNUMS as DON
 
@@ -726,6 +754,8 @@ def _entries() -> dict:
         MemEntry("serve_video_embed@b1", _e_serve("video", 1),
                  argnames=("variables", "video")),
         MemEntry("serve_index_topk", _e_index_topk(),
+                 argnames=("corpus", "valid", "queries")),
+        MemEntry("serve_index_topk@gen", _e_live_index_topk(),
                  argnames=("corpus", "valid", "queries")),
         MemEntry("serve_pool_text_embed@b0", _e_pool_serve("text", 0),
                  argnames=("variables", "tokens"), mesh="1x1 replica"),
@@ -766,6 +796,11 @@ EXPECTED_PEAK_BYTES = {
     "serve_video_embed@b0": 2311104,
     "serve_video_embed@b1": 2503616,
     "serve_index_topk": 2436,
+    # live index (ISSUE 14): same program, shard rows padded to the
+    # capacity RUNG (pow2 >= k: 3 rows/shard -> 4) — the 64-byte delta
+    # vs the frozen entry is the rung headroom, i.e. what pre-provisioned
+    # growth costs per chip at the tiny scale
+    "serve_index_topk@gen": 2500,
     # replica-pool entries (ISSUE 10): per-chip bytes on a replica's OWN
     # single-device mesh.  The pin is the no-double-count property: a
     # pool puts ONE replica per device (group), so a replica's per-chip
@@ -840,6 +875,10 @@ EXPECTED_TOP_CONTRIBUTORS = {
         "variables/params/conv_2c/conv_temporal/kernel",
         "variables/params/mixed_3b/conv_b1_b/conv_spatial/kernel"),
     "serve_index_topk": (
+        "queries",
+        "all_gather float32[8,24]",
+        "all_gather int32[8,24]"),
+    "serve_index_topk@gen": (
         "queries",
         "all_gather float32[8,24]",
         "all_gather int32[8,24]"),

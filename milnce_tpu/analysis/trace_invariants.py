@@ -125,6 +125,10 @@ EXPECTED_COLLECTIVES = {
     # stay collective-free like the single-engine entries
     "serve_pool_text_embed": {},
     "serve_pool_video_embed": {},
+    # live index (ISSUE 14): the generation-swapped index runs the SAME
+    # top-k program — identical pinned communication, whatever
+    # generation is live
+    "serve_live_index": {"all_gather": 2},
 }
 
 
@@ -850,6 +854,50 @@ def _entry_serve_index_topk() -> list[CheckResult]:
     return out
 
 
+def _entry_serve_live_index() -> list[CheckResult]:
+    """Generation-swapped live index (ISSUE 14): the SAME pinned
+    program as ``serve_index_topk`` (2 all_gathers, no f64), plus the
+    tentpole's recompile story — two ingest+swap cycles INSIDE a corpus
+    rung followed by queries must leave the query path's jit cache
+    untouched (``recompiles() == 0``), because swapped generations at
+    one rung are shape-identical."""
+    import jax
+    import numpy as np
+
+    from milnce_tpu.serving.live_index import LiveRetrievalIndex
+
+    _model, _opt, mesh, _state, _batch = _setup()
+    ndev = len(jax.devices())
+    rng = np.random.default_rng(0)
+    dim = _TINY["embedding_dim"]
+    corpus = rng.standard_normal((3 * ndev - 2, dim)).astype(np.float32)
+    index = LiveRetrievalIndex(mesh, corpus, k=3, query_buckets=(ndev,))
+    name = "serve_live_index"
+    try:
+        q = rng.standard_normal((ndev, dim)).astype(np.float32)
+        index.topk(q)
+        for _ in range(2):              # two swaps inside the boot rung
+            index.add(rng.standard_normal((2, dim)).astype(np.float32))
+            if not index.flush(30.0):
+                return [CheckResult(name, "swap", False,
+                                    "ingest flush timed out — the "
+                                    "builder never published")]
+            index.topk(q)
+        n_re = index.recompiles()
+        out = [CheckResult(
+            name, "recompile-across-swaps", n_re == 0,
+            "" if n_re == 0 else f"{n_re} jit-cache entries appeared on "
+            "the QUERY path across generation swaps — a swap is leaking "
+            "a compile (rung rule broken, or the builder stopped "
+            "warming new shapes)")]
+        fn, operands = index.topk_program()
+        qd = jax.device_put(q, index.query_sharding)
+        out += _jaxpr_checks(name, fn, operands + (qd,))
+        return out
+    finally:
+        index.close()
+
+
 ENTRY_POINTS = {
     "train_step_milnce": _entry_train_step_milnce,
     "train_step_milnce_guarded": _entry_train_step_milnce_guarded,
@@ -868,6 +916,7 @@ ENTRY_POINTS = {
     "serve_embed_ladder": _entry_serve_embed_ladder,
     "serve_index_topk": _entry_serve_index_topk,
     "serve_pool_embed": _entry_serve_pool_embed,
+    "serve_live_index": _entry_serve_live_index,
 }
 
 

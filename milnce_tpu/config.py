@@ -438,6 +438,40 @@ class ServeConfig:
                                         # requests shed with HTTP 429 +
                                         # Retry-After (0 = unbounded).
                                         # /healthz and /metrics never shed.
+    continuous_batching: bool = False   # admit requests into partially-
+                                        # filled bucket slots: flush the
+                                        # instant a dispatch lane is free,
+                                        # accumulate while lanes are busy
+                                        # (vLLM-style slot reuse on the
+                                        # fixed ladder; max_delay_ms is
+                                        # then ignored — serving/batcher.py)
+    tiers: str = ""                     # per-tenant SLO classes on the
+                                        # admission controller: priority-
+                                        # ordered 'name:share[,...]' (e.g.
+                                        # 'interactive:1.0,batch:0.5' —
+                                        # each tier may hold at most
+                                        # share*max_inflight rows, so a
+                                        # batch backfill cannot starve
+                                        # interactive traffic).  Requests
+                                        # pick a class via the 'tier'
+                                        # field; '' = untiered.
+    live_index: bool = False            # serve a generation-swapped LIVE
+                                        # index (serving/live_index.py):
+                                        # POST /v1/index/add ingests while
+                                        # serving; swaps are atomic and
+                                        # recompile-free within a corpus
+                                        # rung.  False = the frozen
+                                        # DeviceRetrievalIndex.
+    index_snapshot_dir: str = ""        # live-index corpus checkpoint dir
+                                        # (corpus.npz + index_meta.json):
+                                        # restored at boot when present,
+                                        # written at shutdown ('' = no
+                                        # snapshotting)
+    index_min_shard_rows: int = 0       # live-index per-shard capacity
+                                        # rung floor (0 = sized by k and
+                                        # the boot corpus; raise it to
+                                        # pre-provision headroom so early
+                                        # growth never crosses a rung)
 
 
 @dataclass

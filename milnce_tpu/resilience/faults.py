@@ -40,6 +40,17 @@ serving/pool.py, ROBUSTNESS.md "Serving request path"):
                        process; the pool quarantines it and probes keep
                        failing).
 
+Live-index sites (serving/live_index.py — chaos tests prove a failed
+swap leaves the old generation serving and never wedges the builder):
+
+- ``index.swap_raise``  host; the builder's generation publication
+                       raises just before the atomic swap (exercises
+                       the keep-old-generation + re-queue-rows + retry
+                       path).
+- ``index.ingest_hang`` host; ``LiveRetrievalIndex.add`` sleeps ``x``
+                       seconds (a wedged ingest caller; queries must be
+                       unaffected; default x=5).
+
 Spec grammar (config ``train.faults`` or env ``MILNCE_FAULTS``)::
 
     spec   := clause (';' clause)*
@@ -70,7 +81,8 @@ from milnce_tpu.obs import metrics as obs_metrics
 
 KNOWN_SITES = ("decode.raise", "decode.hang", "ckpt.save_ioerror",
                "grad.nonfinite", "serve.dispatch_raise",
-               "serve.dispatch_hang", "serve.replica_dead")
+               "serve.dispatch_hang", "serve.replica_dead",
+               "index.swap_raise", "index.ingest_hang")
 
 # Process-wide injection telemetry (OBSERVABILITY.md): chaos drills and
 # failure-rate dashboards read how often each site actually fired.

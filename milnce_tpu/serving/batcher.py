@@ -16,6 +16,19 @@ Flush policy (both bounds are SLO knobs, SERVING.md):
   row arrived — a lone request never waits longer than the delay bound
   for company.
 
+**Continuous batching** (``continuous=True`` — the vLLM slot-reuse idea
+adapted to the fixed bucket ladder, SERVING.md "Continuous batching"):
+instead of flush-and-wait, the worker flushes the moment a dispatch
+LANE is free — a lone request never pays ``max_delay_ms`` for company
+that isn't coming — and while every lane is busy, arrivals accumulate
+into the forming batch, filling bucket slots for free (occupancy rises
+exactly when the device is the bottleneck).  ``lanes`` is the number of
+concurrently-dispatchable batches (1 for a single engine; the replica
+count for a pool in pipelined mode); a semaphore bounds in-flight
+batches to it.  Deadlines stay prompt: the lane-wait loop expires aged
+requests at the same ~2 ms resolution the deadline wake gives the
+flush-and-wait path.
+
 Deadline semantics (the request-path analogue of the training side's
 decode watchdog, ROBUSTNESS.md): a request may carry a deadline that
 bounds its QUEUE WAIT.  A request whose deadline passes before its
@@ -54,6 +67,9 @@ _DEADLINE_SLACK_S = 0.002
 # Idle poll period: how often the worker re-checks the closed flag when
 # the queue is empty (bounds close() latency, costs nothing hot).
 _IDLE_POLL_S = 0.05
+# Continuous mode's lane-wait tick: bounds both deadline-expiry
+# staleness and close() latency while every dispatch lane is busy.
+_LANE_POLL_S = 0.002
 
 
 class DeadlineExpired(RuntimeError):
@@ -112,6 +128,12 @@ class DynamicBatcher:
       from a completion callback — so several batches can be in flight
       across pool replicas at once and one wedged replica never blocks
       the flush loop.  ``run_batch`` is ignored when this is set.
+    - ``continuous``: continuous batching (module docstring) — flush the
+      instant a lane is free, accumulate while lanes are busy;
+      ``max_delay_ms`` is ignored (a lone request never waits for
+      company that isn't coming).
+    - ``lanes``: concurrently-in-flight batch bound in continuous mode
+      (the pool's replica count in pipelined mode, else 1).
     """
 
     def __init__(self, run_batch: Callable[[np.ndarray], np.ndarray],
@@ -123,10 +145,17 @@ class DynamicBatcher:
                  recorder: Optional[obs_spans.SpanRecorder] = None,
                  on_flush: Optional[Callable[[float, int], None]] = None,
                  run_batch_async: Optional[Callable[[np.ndarray],
-                                                    Future]] = None):
+                                                    Future]] = None,
+                 continuous: bool = False, lanes: int = 1):
         assert max_batch >= 1
         self._run_batch = run_batch
         self._run_batch_async = run_batch_async
+        self.continuous = bool(continuous)
+        # in-flight batch bound for continuous mode: acquired by the
+        # worker before each flush, released when the flush resolves
+        # (sync: after run_batch; async: in the completion callback)
+        self._lane_sem = (threading.Semaphore(max(1, int(lanes)))
+                          if continuous else None)
         # flush-latency observer ``(dur_ms, live_rows) -> None``: the
         # service feeds its EWMA spike detector here (anomaly-triggered
         # profiler capture).  Invoked on the worker thread AFTER the
@@ -191,6 +220,12 @@ class DynamicBatcher:
         # make a lock-free read of a guarded dict safe)
         self._bucket_children: dict[int, tuple] = {}
         self._children_lock = make_lock("serving.batcher.children")
+        # rows the continuous worker has dequeued into its FORMING batch
+        # (left _q, not yet flushed): depth() must count them or the
+        # admission feasibility floor undercounts by up to max_batch
+        # while the worker parks on busy lanes
+        self._forming = 0                     # guarded-by: _forming_lock
+        self._forming_lock = make_lock("serving.batcher.forming")
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name=f"{name}-worker")
         self._worker.start()
@@ -221,6 +256,9 @@ class DynamicBatcher:
     # ---- worker side ----------------------------------------------------
 
     def _run(self) -> None:
+        if self.continuous:
+            self._run_continuous()
+            return
         while not self._closed.is_set():
             try:
                 first = self._q.get(timeout=_IDLE_POLL_S)
@@ -243,10 +281,55 @@ class DynamicBatcher:
             self._flush(batch)
         self._drain_closed()
 
-    def _flush(self, batch: list[_Request]) -> None:
+    def _run_continuous(self) -> None:
+        """Continuous batching: flush as soon as a lane is free, fill
+        bucket slots from new arrivals while every lane is busy."""
+        while not self._closed.is_set():
+            try:
+                first = self._q.get(timeout=_IDLE_POLL_S)
+            except queue.Empty:
+                continue
+            batch = [first]
+            self._drain_into(batch)
+            self._set_forming(len(batch))
+            got_lane = self._lane_sem.acquire(timeout=_LANE_POLL_S)
+            while not got_lane and not self._closed.is_set():
+                # parked on busy lanes: expire aged requests promptly
+                # and keep topping the forming batch up to the bucket
+                batch = self._expire(batch)
+                self._drain_into(batch)
+                self._set_forming(len(batch))
+                got_lane = self._lane_sem.acquire(timeout=_LANE_POLL_S)
+            self._set_forming(0)
+            if not got_lane:        # closing: fail the collected batch
+                for r in batch:
+                    self._fail_closed(r)
+                break
+            self._flush(batch)      # the flush resolution frees the lane
+        self._drain_closed()
+
+    def _set_forming(self, n: int) -> None:
+        with self._forming_lock:
+            self._forming = n
+
+    def _drain_into(self, batch: list) -> None:
+        """Move whatever is queued RIGHT NOW into ``batch`` (up to the
+        top bucket) without waiting — the continuous-mode accumulator."""
+        while len(batch) < self.max_batch:
+            try:
+                batch.append(self._q.get_nowait())
+            except queue.Empty:
+                return
+
+    def _release_lane(self) -> None:
+        if self._lane_sem is not None:
+            self._lane_sem.release()
+
+    def _expire(self, batch: list) -> list:
+        """Fail (promptly) every request in ``batch`` whose deadline has
+        passed; returns the survivors."""
         now = time.monotonic()
-        live = []
-        expired = 0
+        live, expired = [], 0
         for r in batch:
             if r.deadline is not None and r.deadline < now:
                 r.future.set_exception(DeadlineExpired(
@@ -258,7 +341,12 @@ class DynamicBatcher:
                 live.append(r)
         if expired:
             self._m_expired.inc(expired)
+        return live
+
+    def _flush(self, batch: list[_Request]) -> None:
+        live = self._expire(batch)
         if not live:
+            self._release_lane()
             return
         n = len(live)
         try:
@@ -285,10 +373,12 @@ class DynamicBatcher:
                 out = np.asarray(self._run_batch(rows))
         except Exception as exc:
             # batch failure -> every caller sees the error (never a hang)
+            self._release_lane()
             for r in live:
                 r.future.set_exception(exc)
             self._m_batch_errors.inc()
             return
+        self._release_lane()
         for i, r in enumerate(live):
             r.future.set_result(out[i])
         self._account_flush(bucket, n, flush_span["dur_ms"])
@@ -299,7 +389,8 @@ class DynamicBatcher:
         scatter per-row results / the batch error, then the same
         accounting as a synchronous flush.  The timed record is an
         ``event`` with ``dur_ms`` (a span cannot straddle threads)."""
-        try:
+        self._release_lane()            # frees the lane for the NEXT
+        try:                            # batch before scattering results
             out = np.asarray(f.result())
         except Exception as exc:
             for r in live:
@@ -342,21 +433,25 @@ class DynamicBatcher:
     def _past_ms(r: _Request, now: float) -> float:
         return max(0.0, (now - r.deadline) * 1000.0) if r.deadline else 0.0
 
+    @staticmethod
+    def _fail_closed(r: _Request) -> None:
+        from concurrent.futures import InvalidStateError
+
+        try:
+            r.future.set_exception(RuntimeError("batcher closed"))
+        except InvalidStateError:
+            pass                        # the other drainer got it first
+
     def _drain_closed(self) -> None:
         """Fail (never drop) anything still queued when the batcher
         closes.  Callable from both the exiting worker and a racing
         ``submit`` thread — double-resolution is tolerated."""
-        from concurrent.futures import InvalidStateError
-
         while True:
             try:
                 r = self._q.get_nowait()
             except queue.Empty:
                 return
-            try:
-                r.future.set_exception(RuntimeError("batcher closed"))
-            except InvalidStateError:
-                pass                    # the other drainer got it first
+            self._fail_closed(r)
 
     # ---- lifecycle / observability --------------------------------------
 
@@ -365,9 +460,12 @@ class DynamicBatcher:
         self._worker.join(timeout)
 
     def depth(self) -> int:
-        """Requests currently queued (approximate — stdlib qsize).  The
+        """Requests currently queued (approximate — stdlib qsize) plus
+        any rows the continuous worker holds in its forming batch.  The
         admission controller's feasibility input (service.py)."""
-        return self._q.qsize()
+        with self._forming_lock:
+            forming = self._forming
+        return self._q.qsize() + forming
 
     def stats(self) -> dict:
         """Counters + the batch-occupancy histogram (bucket -> how full
@@ -383,9 +481,14 @@ class DynamicBatcher:
             occupancy[str(b)] = {
                 "flushes": f, "rows": rows,
                 "mean_fill": (rows / (f * b)) if f else 0.0}
+        # flushes read BEFORE requests: each read is atomic but the PAIR
+        # is only monotonically consistent in this order (a reader
+        # preempted between the two reads then sees requests >= the
+        # causal floor of the flush count, never flushes > requests)
+        flushes = int(self._m_flushes.value)
         return {
             "requests": int(self._m_requests.value),
-            "flushes": int(self._m_flushes.value),
+            "flushes": flushes,
             "deadline_expired": int(self._m_expired.value),
             "batch_errors": int(self._m_batch_errors.value),
             "occupancy": occupancy,

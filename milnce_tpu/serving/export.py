@@ -40,6 +40,80 @@ ARRAYS_FILE = "arrays.npz"
 METADATA_FILE = "metadata.json"
 FORMAT_VERSION = 1
 
+# Live-index corpus snapshot (serving/live_index.py): the SAME boring
+# two-file shape as the params export — one npz (the corpus under the
+# 'emb' key, the exact array ``--serve.corpus_npz`` accepts) plus one
+# versioned metadata json — so an ingesting service can checkpoint its
+# grown corpus and a restore (or a cold boot off the npz alone) is
+# bit-exact.
+INDEX_ARRAYS_FILE = "corpus.npz"
+INDEX_METADATA_FILE = "index_meta.json"
+INDEX_FORMAT_VERSION = 1
+
+
+def export_corpus_snapshot(out_dir: str, embeddings: np.ndarray, *,
+                           generation: int, k: int,
+                           source: str = "") -> str:
+    """Write a live-index corpus snapshot; returns ``out_dir``.
+
+    ``embeddings`` is the LIVE generation's (N, D) float32 host corpus
+    (pending ingest rows are the caller's business — flush first)."""
+    emb = np.ascontiguousarray(embeddings, dtype=np.float32)
+    if emb.ndim != 2:
+        raise ValueError(f"expected (N, D) embeddings, got {emb.shape}")
+    os.makedirs(out_dir, exist_ok=True)
+    meta = {
+        "format_version": INDEX_FORMAT_VERSION,
+        "generator": "milnce_tpu/serving/export.py (corpus snapshot)",
+        "generation": int(generation),
+        "k": int(k),
+        "size": int(emb.shape[0]),
+        "dim": int(emb.shape[1]),
+        "source": source,
+    }
+    # tmp-write + atomic rename, corpus first: an ingesting service
+    # snapshots into the SAME directory every shutdown, so an in-place
+    # write killed mid-stream would destroy the previous good snapshot
+    # (the exact crash-window class train/checkpoint.py defends
+    # against).  Worst case after a crash between the two renames is a
+    # NEW corpus beside the OLD metadata — load_corpus_snapshot's
+    # shape-vs-metadata check turns a size-changing tear into a loud
+    # boot error instead of silently serving a mixed snapshot.
+    arrays_path = os.path.join(out_dir, INDEX_ARRAYS_FILE)
+    meta_path = os.path.join(out_dir, INDEX_METADATA_FILE)
+    # np.savez force-appends '.npz' to names missing it — keep the tmp
+    # name's suffix so the path savez writes IS the path we rename
+    tmp_arrays = os.path.join(out_dir, f".tmp-{os.getpid()}-corpus.npz")
+    tmp_meta = meta_path + f".tmp-{os.getpid()}"
+    try:
+        np.savez(tmp_arrays, emb=emb)
+        with open(tmp_meta, "w") as fh:
+            json.dump(meta, fh, indent=2, sort_keys=True)
+        os.replace(tmp_arrays, arrays_path)
+        os.replace(tmp_meta, meta_path)
+    finally:
+        for leftover in (tmp_arrays, tmp_meta):
+            if os.path.exists(leftover):
+                os.unlink(leftover)
+    return out_dir
+
+
+def load_corpus_snapshot(snap_dir: str) -> tuple[dict, np.ndarray]:
+    """Read a corpus snapshot -> (metadata dict, (N, D) f32 corpus)."""
+    with open(os.path.join(snap_dir, INDEX_METADATA_FILE)) as fh:
+        meta = json.load(fh)
+    if meta.get("format_version") != INDEX_FORMAT_VERSION:
+        raise ValueError(
+            f"corpus snapshot format {meta.get('format_version')!r} "
+            f"unsupported (this build reads {INDEX_FORMAT_VERSION})")
+    with np.load(os.path.join(snap_dir, INDEX_ARRAYS_FILE)) as z:
+        emb = np.ascontiguousarray(z["emb"], dtype=np.float32)
+    if emb.shape != (meta["size"], meta["dim"]):
+        raise ValueError(f"snapshot corpus shape {emb.shape} disagrees "
+                         f"with its metadata ({meta['size']}, "
+                         f"{meta['dim']}) — truncated or mixed artifact")
+    return meta, emb
+
 
 def _key_name(k) -> str:
     for attr in ("key", "name", "idx"):

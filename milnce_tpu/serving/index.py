@@ -45,6 +45,49 @@ from milnce_tpu.serving.batcher import pad_rows
 from milnce_tpu.serving.engine import DEVICE_DISPATCH_LOCK
 
 
+def make_topk_fn(mesh: Mesh, data_axis: str, k: int):
+    """The jitted sharded top-k program (the ``serve_index_topk`` trace
+    invariant's subject): each data shard scores the replicated query
+    block against its local corpus rows, takes a LOCAL top-k, and the
+    per-shard (Q, k) candidate lists ride ONE all_gather each for scores
+    and indices before an exact global top-k.  Shared by the frozen
+    :class:`DeviceRetrievalIndex` and the generation-swapped
+    :class:`~milnce_tpu.serving.live_index.LiveRetrievalIndex` — one
+    program, one set of pinned collectives, however the corpus is
+    managed."""
+
+    def local_topk(corpus_l, valid_l, queries):
+        scores = queries @ corpus_l.T                    # (Q, R_local)
+        col = lax.iota(jnp.int32, corpus_l.shape[0])
+        scores = jnp.where(col[None, :] < valid_l[0], scores, -jnp.inf)
+        s, i = lax.top_k(scores, k)                      # local winners
+        gidx = i + lax.axis_index(data_axis) * corpus_l.shape[0]
+        s_all = lax.all_gather(s, data_axis, axis=1, tiled=True)
+        i_all = lax.all_gather(gidx, data_axis, axis=1, tiled=True)
+        s_top, j = lax.top_k(s_all, k)                   # exact global
+        return s_top, jnp.take_along_axis(i_all, j, axis=1)
+
+    return jax.jit(shard_map(
+        local_topk, mesh=mesh,
+        in_specs=(P(data_axis), P(data_axis), P()),
+        out_specs=(P(), P()), check_vma=False))
+
+
+def shard_corpus(emb: np.ndarray, n_data: int, rows: int
+                 ) -> tuple[np.ndarray, np.ndarray]:
+    """Pad ``(size, D)`` embeddings to ``rows`` rows per data shard ->
+    (``(rows * n_data, D)`` padded corpus, ``(n_data,)`` int32 per-shard
+    valid-row counts).  Pad rows are zeros and masked to -inf inside the
+    top-k program, so they can never be retrieved."""
+    size, dim = emb.shape
+    corpus = np.zeros((rows * n_data, dim), np.float32)
+    corpus[:size] = emb
+    valid = np.asarray(
+        [max(0, min(size, (s + 1) * rows) - s * rows)
+         for s in range(n_data)], np.int32)
+    return corpus, valid
+
+
 class DeviceRetrievalIndex:
     """Immutable sharded corpus + fixed-k jitted top-k retrieval.
 
@@ -77,34 +120,13 @@ class DeviceRetrievalIndex:
         # Pad the corpus so rows split evenly AND every shard holds at
         # least k rows (lax.top_k needs k <= local extent).
         rows = max(-(-self.size // n_data), self.k)
-        total = rows * n_data
-        corpus = np.zeros((total, self.dim), np.float32)
-        corpus[:self.size] = emb
-        valid = np.asarray(
-            [max(0, min(self.size, (s + 1) * rows) - s * rows)
-             for s in range(n_data)], np.int32)
+        corpus, valid = shard_corpus(emb, n_data, rows)
 
         sh_rows = batch_sharding(mesh, data_axis)
         self._corpus = jax.device_put(corpus, sh_rows)       # device-resident
         self._valid = jax.device_put(valid, sh_rows)
         self._query_sh = replicated(mesh)
-        k_ = self.k
-
-        def local_topk(corpus_l, valid_l, queries):
-            scores = queries @ corpus_l.T                    # (Q, R_local)
-            col = lax.iota(jnp.int32, corpus_l.shape[0])
-            scores = jnp.where(col[None, :] < valid_l[0], scores, -jnp.inf)
-            s, i = lax.top_k(scores, k_)                     # local winners
-            gidx = i + lax.axis_index(data_axis) * corpus_l.shape[0]
-            s_all = lax.all_gather(s, data_axis, axis=1, tiled=True)
-            i_all = lax.all_gather(gidx, data_axis, axis=1, tiled=True)
-            s_top, j = lax.top_k(s_all, k_)                  # exact global
-            return s_top, jnp.take_along_axis(i_all, j, axis=1)
-
-        self._fn = jax.jit(shard_map(
-            local_topk, mesh=mesh,
-            in_specs=(P(data_axis), P(data_axis), P()),
-            out_specs=(P(), P()), check_vma=False))
+        self._fn = make_topk_fn(mesh, data_axis, self.k)
         # call accounting is hit straight off concurrent request threads
         # — its own lock, never the dispatch lock (graftlint GL010: the
         # bare `_calls += 1` here lost increments under contention)

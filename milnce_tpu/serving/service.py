@@ -16,10 +16,20 @@ batcher anyway — handler threads just block on futures.
 Endpoints (JSON in/out):
 
 - ``POST /v1/query``       {"token_ids": [[...]] | "sentences": [...],
-                            "k": int?, "timeout_ms": float?}
+                            "k": int?, "timeout_ms": float?, "tier": str?}
                            -> {"results": [{"indices": [...],
-                                            "scores": [...]}, ...]}
+                                            "scores": [...]}, ...],
+                               "index_generation": int?}  (live index
+                           only — the freshness stamp)
 - ``POST /v1/embed_text``  same inputs -> {"embeddings": [[...], ...]}
+- ``POST /v1/index/add``   {"embeddings": [[...]] | "clips": [[...]],
+                            "wait": bool?} — live-index ingest: raw
+                           clips route through the video embed tower,
+                           precomputed embeddings go straight to the
+                           pending buffer; ``wait`` blocks until the
+                           generation swap publishes the rows
+                           (serving/live_index.py; 400 on a frozen
+                           index).
 - ``GET  /healthz``        resilience-style counters: uptime, request /
                            error / deadline-expired totals, engine
                            recompile count, batch-occupancy histogram,
@@ -108,6 +118,33 @@ class DegradedError(RuntimeError):
         self.retry_after_ms = float(retry_after_ms)
 
 
+def parse_tier_spec(spec: str) -> dict:
+    """``serve.tiers`` grammar: ``name:share[,name:share...]`` ->
+    ordered ``{name: share}`` — PRIORITY order (first = highest; a
+    request naming no tier gets the first one).  ``share`` in (0, 1] is
+    the fraction of ``max_inflight`` that tier may occupy: the
+    per-tenant SLO-class mechanism — a ``batch:0.5`` backfill tier can
+    never hold more than half the admission budget, so the
+    ``interactive:1.0`` tier always has headroom (it can't be starved).
+    Malformed items and out-of-range shares raise ValueError at config
+    time, not as a silently-ignored tier."""
+    out: dict[str, float] = {}
+    for item in filter(None, (c.strip() for c in spec.split(","))):
+        if ":" not in item:
+            raise ValueError(f"tier item {item!r} missing ':share' "
+                             "(grammar: name:share[,name:share...])")
+        name, _, share = item.partition(":")
+        name = name.strip()
+        if not name or name in out:
+            raise ValueError(f"bad/duplicate tier name in {item!r}")
+        share_f = float(share)
+        if not 0.0 < share_f <= 1.0:
+            raise ValueError(f"tier {name!r} share {share_f} outside "
+                             "(0, 1]")
+        out[name] = share_f
+    return out
+
+
 class AdmissionController:
     """Bounded global queue + deadline-feasibility load shedding.
 
@@ -135,17 +172,30 @@ class AdmissionController:
     batcher flush durations (flush == dispatch there), the pooled
     service feeds the pool's per-dispatch latencies — an async flush's
     submit-to-resolution time includes replica queue wait and would
-    inflate the "provable" floor into false 429s."""
+    inflate the "provable" floor into false 429s.
+
+    **Per-tenant SLO classes** (``tiers`` — :func:`parse_tier_spec`):
+    each tier may occupy at most ``share x max_inflight`` admitted rows;
+    past it, THAT tier sheds (``tier_overload``, HTTP 429) while
+    higher-priority tiers keep admitting into their own headroom — a
+    batch backfill job cannot starve interactive traffic.  A request
+    naming no tier rides the FIRST (highest-priority) tier; an unknown
+    tier is a loud ValueError (HTTP 400), never a silent default."""
 
     def __init__(self, max_inflight: int, *, max_batch: int, lanes: int = 1,
                  depth_fn=None,
-                 registry: Optional[obs_metrics.MetricsRegistry] = None):
+                 registry: Optional[obs_metrics.MetricsRegistry] = None,
+                 tiers=None):
         self.max_inflight = int(max_inflight)
         self.max_batch = max(1, int(max_batch))
         self.lanes = max(1, int(lanes))
         self._depth_fn = depth_fn           # batcher queue depth (rows)
+        self.tiers = (parse_tier_spec(tiers) if isinstance(tiers, str)
+                      else dict(tiers or {}))
+        self.default_tier = next(iter(self.tiers), None)
         self._lock = make_lock("serving.admission")
         self._inflight = 0                  # guarded-by: _lock
+        self._tier_inflight = {t: 0 for t in self.tiers}  # guarded-by: _lock
         self._flush_floor_ms: Optional[float] = None  # guarded-by: _lock
         self._flush_mean_ms: Optional[float] = None   # guarded-by: _lock
         reg = registry if registry is not None \
@@ -156,11 +206,34 @@ class AdmissionController:
         reg.gauge("milnce_serve_admission_inflight",
                   "rows admitted and not yet resolved",
                   fn=lambda: float(self.inflight))
+        self._f_tier_shed = None
+        if self.tiers:
+            self._f_tier_shed = reg.counter(
+                "milnce_serve_tier_shed_total",
+                "admission refusals per SLO tier (HTTP 429)",
+                ("tier", "reason"))
+            g = reg.gauge("milnce_serve_tier_inflight",
+                          "rows admitted and unresolved per SLO tier",
+                          ("tier",))
+            for name in self.tiers:
+                g.labels(tier=name).bind(
+                    lambda n=name: float(self.tier_inflight(n)))
 
     @property
     def inflight(self) -> int:
         with self._lock:
             return self._inflight
+
+    def tier_inflight(self, tier: str) -> int:
+        with self._lock:
+            return self._tier_inflight.get(tier, 0)
+
+    def tier_cap(self, tier: str) -> int:
+        """Rows tier ``tier`` may hold: ``ceil(share * max_inflight)``
+        (unbounded while the controller is unarmed)."""
+        if self.max_inflight <= 0:
+            return 0
+        return max(1, math.ceil(self.tiers[tier] * self.max_inflight))
 
     def observe_flush(self, dur_ms: float, rows: int) -> None:
         """Fed from the batcher's ``on_flush`` hook: tracks the fastest
@@ -171,17 +244,34 @@ class AdmissionController:
             self._flush_mean_ms = dur_ms if self._flush_mean_ms is None \
                 else 0.8 * self._flush_mean_ms + 0.2 * dur_ms
 
-    def _shed(self, reason: str, msg: str, retry_after_ms: float):
+    def _shed(self, reason: str, msg: str, retry_after_ms: float,
+              tier: Optional[str] = None):
         self._f_shed.labels(reason=reason).inc()
+        if tier is not None and self._f_tier_shed is not None:
+            self._f_tier_shed.labels(tier=tier, reason=reason).inc()
         raise ShedError(msg, reason, retry_after_ms)
 
+    def resolve_tier(self, tier: Optional[str]) -> Optional[str]:
+        """None -> the highest-priority tier; unknown names are a loud
+        error (HTTP 400), never a silent default tier."""
+        if not self.tiers:
+            return None
+        if tier is None:
+            return self.default_tier
+        if tier not in self.tiers:
+            raise ValueError(f"unknown SLO tier {tier!r} "
+                             f"(tiers: {', '.join(self.tiers)})")
+        return tier
+
     @contextlib.contextmanager
-    def admit(self, rows: int, timeout_ms: Optional[float]):
+    def admit(self, rows: int, timeout_ms: Optional[float],
+              tier: Optional[str] = None):
         """Reserve ``rows`` slots for the duration of the request, or
         refuse with :class:`ShedError` — the refusal happens BEFORE
         anything is queued, so a shed request costs nothing downstream
         and can never hang."""
         rows = int(rows)
+        tier = self.resolve_tier(tier)
         shed = None
         with self._lock:
             if (self.max_inflight > 0
@@ -190,6 +280,16 @@ class AdmissionController:
                 shed = ("overload",
                         f"{self._inflight} rows in flight + {rows} would "
                         f"exceed max_inflight={self.max_inflight}", hint)
+            elif (tier is not None and self.max_inflight > 0
+                    and self._tier_inflight[tier] + rows
+                    > self.tier_cap(tier)):
+                hint = self._flush_mean_ms or 100.0
+                shed = ("tier_overload",
+                        f"tier {tier!r} holds "
+                        f"{self._tier_inflight[tier]} rows + {rows} would "
+                        f"exceed its share cap {self.tier_cap(tier)} "
+                        f"(share {self.tiers[tier]:g} of "
+                        f"max_inflight={self.max_inflight})", hint)
             elif self.max_inflight > 0 and timeout_ms and timeout_ms > 0 \
                     and self._flush_floor_ms is not None \
                     and self._depth_fn is not None:
@@ -203,25 +303,43 @@ class AdmissionController:
                             f"({batches_ahead} batches ahead)", floor_ms)
             if shed is None:
                 self._inflight += rows
+                if tier is not None:
+                    self._tier_inflight[tier] += rows
         if shed is not None:
-            self._shed(*shed)
+            self._shed(*shed, tier=tier)
         try:
             yield
         finally:
             with self._lock:
                 self._inflight -= rows
+                if tier is not None:
+                    self._tier_inflight[tier] -= rows
 
     def stats(self) -> dict:
         with self._lock:
             inflight = self._inflight
+            tier_inflight = dict(self._tier_inflight)
             floor = self._flush_floor_ms
-        return {
+        out = {
             "max_inflight": self.max_inflight,
             "inflight": inflight,
             "flush_floor_ms": floor,
             "shed": {str(labels[0]): int(child.value)
                      for labels, child in self._f_shed.items()},
         }
+        if self.tiers:
+            tier_shed: dict[str, dict] = {t: {} for t in self.tiers}
+            for labels, child in self._f_tier_shed.items():
+                tier_shed.setdefault(str(labels[0]), {})[
+                    str(labels[1])] = int(child.value)
+            out["tiers"] = {
+                t: {"share": share,
+                    "cap": self.tier_cap(t) if self.max_inflight > 0
+                    else None,
+                    "inflight": tier_inflight[t],
+                    "shed": tier_shed.get(t, {})}
+                for t, share in self.tiers.items()}
+        return out
 
 
 class RetrievalService:
@@ -233,7 +351,7 @@ class RetrievalService:
                  registry: Optional[obs_metrics.MetricsRegistry] = None,
                  recorder: Optional[obs_spans.SpanRecorder] = None,
                  capture=None, anomaly_ratio: float = 3.0,
-                 max_inflight: int = 0):
+                 max_inflight: int = 0, tiers="", continuous: bool = False):
         self.engine = engine
         self.index = index
         self.tokenizer = tokenizer
@@ -272,7 +390,7 @@ class RetrievalService:
             max_inflight, max_batch=engine.max_batch,
             lanes=(len(self._pool.replicas) if self._pool is not None else 1),
             depth_fn=lambda: self._batcher.depth(),
-            registry=self.registry)
+            registry=self.registry, tiers=tiers)
 
         def _on_flush(dur_ms: float, rows: int) -> None:
             # one hook, two consumers: the EWMA spike detector (anomaly
@@ -293,7 +411,12 @@ class RetrievalService:
             # pooled: submit-and-move-on so batches pipeline across
             # replicas and one wedged replica never blocks the flush loop
             run_batch_async=(self._pool.submit_text
-                             if self._pool is not None else None))
+                             if self._pool is not None else None),
+            # continuous batching (SERVING.md): one dispatch lane per
+            # pool replica; the single-engine path has exactly one
+            continuous=continuous,
+            lanes=(len(self._pool.replicas)
+                   if self._pool is not None else 1))
         if self._pool is not None:
             # the pool's per-dispatch latencies feed the same spike
             # detector (the anomaly->capture path sees replica-level
@@ -339,7 +462,8 @@ class RetrievalService:
     # ---- embedding path --------------------------------------------------
 
     def embed_text_ids(self, token_ids: np.ndarray,
-                       timeout_ms: Optional[float] = None) -> np.ndarray:
+                       timeout_ms: Optional[float] = None,
+                       tier: Optional[str] = None) -> np.ndarray:
         """(n, W) int32 -> (n, D): cache hits answered on host, misses
         batched through the engine; results land back in the cache.
 
@@ -347,7 +471,8 @@ class RetrievalService:
         queue); a miss that fails because no replica is healthy becomes
         :class:`DegradedError` — the degradation ladder's cache-only
         tier (an all-hit request still succeeds because it never reaches
-        the batcher)."""
+        the batcher).  ``tier`` names the request's SLO class when the
+        controller has tiers configured (None = highest priority)."""
         rows = np.ascontiguousarray(token_ids, dtype=np.int32)
         if rows.ndim != 2:
             raise ValueError(f"expected (n, W) token ids, got {rows.shape}")
@@ -357,7 +482,7 @@ class RetrievalService:
         # the check for every default-deadline client)
         eff_timeout_ms = (self._default_timeout_ms if timeout_ms is None
                           else float(timeout_ms))
-        with self._admission.admit(rows.shape[0], eff_timeout_ms):
+        with self._admission.admit(rows.shape[0], eff_timeout_ms, tier):
             keys = [token_key(r) for r in rows]
             out: list[Optional[np.ndarray]] = [self.cache.get(k)
                                                for k in keys]
@@ -394,10 +519,17 @@ class RetrievalService:
 
     # ---- query path ------------------------------------------------------
 
-    def query_ids(self, token_ids: np.ndarray, k: Optional[int] = None,
-                  timeout_ms: Optional[float] = None
-                  ) -> tuple[np.ndarray, np.ndarray]:
-        """(n, W) token ids -> ((n, k) scores, (n, k) corpus indices)."""
+    def query_ids_with_gen(self, token_ids: np.ndarray,
+                           k: Optional[int] = None,
+                           timeout_ms: Optional[float] = None,
+                           tier: Optional[str] = None
+                           ) -> tuple[np.ndarray, np.ndarray,
+                                      Optional[int]]:
+        """(n, W) token ids -> ((n, k) scores, (n, k) corpus indices,
+        index generation).  The generation is the freshness stamp a
+        live index answers with (``/v1/query`` surfaces it as
+        ``index_generation`` so clients can detect a stale read); a
+        frozen index answers None."""
         if self.index is None:
             raise ValueError("service built without a retrieval index")
         k = self.index.k if k is None else int(k)
@@ -405,19 +537,72 @@ class RetrievalService:
             raise ValueError(f"k={k} outside [1, index k={self.index.k}]")
         self._m_queries.inc(len(token_ids))
         try:
-            emb = self.embed_text_ids(token_ids, timeout_ms)
-            scores, idx = self.index.topk(emb)
+            emb = self.embed_text_ids(token_ids, timeout_ms, tier)
+            if hasattr(self.index, "topk_with_gen"):
+                scores, idx, gen = self.index.topk_with_gen(emb)
+            else:
+                scores, idx = self.index.topk(emb)
+                gen = None
         except (ShedError, DegradedError, PoolSaturated, PoolUnavailable):
             raise        # refusals, not failures: counted on their own
         except Exception:
             self._m_errors.inc(len(token_ids))
             raise
-        return scores[:, :k], idx[:, :k]
+        return scores[:, :k], idx[:, :k], gen
+
+    def query_ids(self, token_ids: np.ndarray, k: Optional[int] = None,
+                  timeout_ms: Optional[float] = None,
+                  tier: Optional[str] = None
+                  ) -> tuple[np.ndarray, np.ndarray]:
+        """(n, W) token ids -> ((n, k) scores, (n, k) corpus indices)."""
+        scores, idx, _ = self.query_ids_with_gen(token_ids, k, timeout_ms,
+                                                 tier)
+        return scores, idx
+
+    def query_sentences_with_gen(self, sentences, k: Optional[int] = None,
+                                 timeout_ms: Optional[float] = None,
+                                 tier: Optional[str] = None):
+        return self.query_ids_with_gen(self._encode(sentences), k,
+                                       timeout_ms, tier)
 
     def query_sentences(self, sentences, k: Optional[int] = None,
-                        timeout_ms: Optional[float] = None
+                        timeout_ms: Optional[float] = None,
+                        tier: Optional[str] = None
                         ) -> tuple[np.ndarray, np.ndarray]:
-        return self.query_ids(self._encode(sentences), k, timeout_ms)
+        return self.query_ids(self._encode(sentences), k, timeout_ms, tier)
+
+    # ---- write path (live index ingest) ----------------------------------
+
+    def index_add(self, embeddings=None, clips=None, *, wait: bool = False,
+                  timeout_s: float = 30.0) -> dict:
+        """Ingest corpus rows into a LIVE index: either precomputed
+        ``(n, D)`` embeddings, or raw ``(n, T, H, W, 3)`` uint8 clips
+        routed through the SAME video embed tower serving uses (pooled
+        when the service is pooled) — served numbers stay eval numbers
+        for ingested rows too.  ``wait=True`` blocks until the rows are
+        swapped live and reports the published generation."""
+        if self.index is None or not hasattr(self.index, "add"):
+            raise ValueError("service index is not a live index — boot "
+                             "with serving/live_index.py (or "
+                             "--serve.live_index) to ingest online")
+        if (embeddings is None) == (clips is None):
+            raise ValueError("exactly one of 'embeddings' (n, D floats) "
+                             "or 'clips' (n, T, H, W, 3 uint8) required")
+        if clips is not None:
+            rows = np.ascontiguousarray(clips, dtype=np.uint8)
+            top = self.engine.max_batch
+            emb = np.concatenate(
+                [self.engine.embed_video(rows[lo:lo + top])
+                 for lo in range(0, rows.shape[0], top)])
+        else:
+            emb = np.ascontiguousarray(embeddings, dtype=np.float32)
+        out = self.index.add(emb)
+        out["rows"] = int(emb.shape[0])
+        if wait:
+            out["live"] = self.index.flush(timeout_s)
+            out["generation"] = self.index.generation
+            out["size"] = self.index.size
+        return out
 
     # ---- lifecycle / observability --------------------------------------
 
@@ -542,17 +727,28 @@ class _Handler(BaseHTTPRequestHandler):
             length = int(self.headers.get("Content-Length", 0))
             req = json.loads(self.rfile.read(length) or b"{}")
             if self.path == "/v1/query":
-                scores, idx = self._dispatch(self.service.query_ids,
-                                             self.service.query_sentences,
-                                             req)
-                self._reply(200, {"results": [
+                scores, idx, gen = self._dispatch(
+                    self.service.query_ids_with_gen,
+                    self.service.query_sentences_with_gen, req)
+                payload = {"results": [
                     {"indices": row_i.tolist(), "scores": row_s.tolist()}
-                    for row_s, row_i in zip(scores, idx)]})
+                    for row_s, row_i in zip(scores, idx)]}
+                if gen is not None:
+                    # freshness stamp: the live-index generation this
+                    # ranking was answered from (SERVING.md "Live index")
+                    payload["index_generation"] = int(gen)
+                self._reply(200, payload)
             elif self.path == "/v1/embed_text":
                 rows = self._token_rows(req)
                 emb = self.service.embed_text_ids(
-                    rows, req.get("timeout_ms"))
+                    rows, req.get("timeout_ms"), req.get("tier"))
                 self._reply(200, {"embeddings": emb.tolist()})
+            elif self.path == "/v1/index/add":
+                out = self.service.index_add(
+                    embeddings=req.get("embeddings"),
+                    clips=req.get("clips"),
+                    wait=bool(req.get("wait", False)))
+                self._reply(200, out)
             elif self.path == "/obs/capture":
                 # manual profiler-capture arm; the capture object
                 # enforces the one-shot/cooldown budget and reports a
@@ -588,11 +784,12 @@ class _Handler(BaseHTTPRequestHandler):
         return self.service._encode(req["sentences"])
 
     def _dispatch(self, by_ids, by_sentences, req: dict):
-        k, t = req.get("k"), req.get("timeout_ms")
+        k, t, tier = req.get("k"), req.get("timeout_ms"), req.get("tier")
         if "token_ids" in req:
-            return by_ids(np.asarray(req["token_ids"], np.int32), k, t)
+            return by_ids(np.asarray(req["token_ids"], np.int32), k, t,
+                          tier)
         if "sentences" in req:
-            return by_sentences(req["sentences"], k, t)
+            return by_sentences(req["sentences"], k, t, tier)
         raise ValueError("request needs 'token_ids' or 'sentences'")
 
 
@@ -671,7 +868,7 @@ def main(argv=None) -> None:
         if recorded and os.path.exists(recorded):
             tokenizer = Tokenizer.from_npy(recorded,
                                            max_words=engine.text_words)
-    index = None
+    corpus = None
     if s.corpus_npz:
         with np.load(s.corpus_npz) as z:
             if "emb" in z.files:            # the documented contract
@@ -684,6 +881,28 @@ def main(argv=None) -> None:
                     f"{z.files} — store the corpus under the 'emb' key "
                     "(np.savez(..., emb=embeddings)) so the index can't "
                     "silently build over the wrong array")
+    index = None
+    if s.live_index:
+        from milnce_tpu.serving.export import INDEX_METADATA_FILE
+        from milnce_tpu.serving.live_index import LiveRetrievalIndex
+
+        live_kwargs = dict(query_buckets=engine.buckets,
+                           data_axis=cfg.parallel.data_axis,
+                           min_shard_rows=s.index_min_shard_rows,
+                           registry=obs_metrics.registry())
+        snap = s.index_snapshot_dir
+        if snap and os.path.exists(os.path.join(snap,
+                                                INDEX_METADATA_FILE)):
+            # a snapshot resumes the ingesting service where it left
+            # off (generation counter included); --serve.corpus_npz is
+            # ignored in that case — the snapshot IS the corpus
+            index = LiveRetrievalIndex.restore(snap, mesh, k=s.topk,
+                                               **live_kwargs)
+        else:
+            index = LiveRetrievalIndex(mesh, corpus, k=s.topk,
+                                       dim=engine.embed_dim,
+                                       **live_kwargs)
+    elif corpus is not None:
         index = DeviceRetrievalIndex(mesh, corpus, k=s.topk,
                                      query_buckets=engine.buckets,
                                      data_axis=cfg.parallel.data_axis)
@@ -704,8 +923,23 @@ def main(argv=None) -> None:
         # also exposes anything other subsystems record process-wide
         registry=obs_metrics.registry(),
         capture=capture, anomaly_ratio=s.anomaly_ratio,
-        max_inflight=s.max_inflight)
+        max_inflight=s.max_inflight, tiers=s.tiers,
+        continuous=s.continuous_batching)
     server = serve_http(service, s.host, s.port)
+
+    # graceful shutdown: SIGTERM/SIGINT must unwind through the finally
+    # below (live-index snapshot, batcher/pool close) instead of killing
+    # the process mid-write.  shutdown() blocks until serve_forever
+    # returns, so it must run OFF the main thread (the handler interrupts
+    # serve_forever's own poll loop).
+    import signal
+    import threading
+
+    def _graceful(signum, frame):
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
     # flush: operators poll a redirected log for this readiness line
     print(f"milnce-serve: listening on http://{s.host}:"
           f"{server.server_address[1]} (buckets {engine.buckets}, "
@@ -719,6 +953,24 @@ def main(argv=None) -> None:
     finally:
         server.server_close()
         service.close()
+        if s.live_index and index is not None:
+            if s.index_snapshot_dir:
+                # checkpoint the grown corpus so the next boot resumes
+                # the generation instead of re-ingesting from scratch
+                if not index.flush(timeout=30.0):
+                    # acknowledged-but-unpublished rows exist and could
+                    # not be swapped in time (wedged/failing builder) —
+                    # the snapshot below is the LIVE generation only;
+                    # dropping ingest silently would betray the 200s
+                    # those adds already returned
+                    st = index.stats()
+                    print(f"milnce-serve: WARNING — shutdown flush timed "
+                          f"out with {st['pending_rows']} ingested rows "
+                          f"unpublished ({st['swap_failures']} swap "
+                          f"failures); snapshot covers generation "
+                          f"{st['generation']} only", flush=True)
+                index.snapshot(s.index_snapshot_dir)
+            index.close()
         if s.replicas > 1:
             engine.close()
 

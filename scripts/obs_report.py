@@ -80,6 +80,14 @@ GATE_DIRECTIONS = {
 }
 
 
+def gate_direction(name: str) -> str:
+    """Direction for a gate metric name.  Per-tier metrics (ISSUE 14 —
+    serve_bench ``--tiers``) are ``<base>@<tier>`` and inherit the base
+    metric's direction, so ``latency_ms_p99@interactive`` gates exactly
+    like the aggregate p99."""
+    return GATE_DIRECTIONS[name.partition("@")[0]]
+
+
 def _percentile(sorted_vals: list, q: float) -> float:
     """Linear-interpolated percentile over an already-sorted list."""
     if not sorted_vals:
@@ -178,6 +186,23 @@ def gate_metrics(artifact: dict) -> dict[str, float]:
         v = doc.get(key)
         if isinstance(v, (int, float)):
             out[key] = float(v)
+    # per-tier serve_bench block (ISSUE 14): each SLO tier contributes
+    # its own p50/p99/qps/error_rate as <base>@<tier> gate metrics, so
+    # a chaos run pins "interactive p99 inside its SLO" directly
+    tiers = doc.get("tiers")
+    if isinstance(tiers, dict):
+        for tier, td in sorted(tiers.items()):
+            if not isinstance(td, dict):
+                continue
+            lat = td.get("latency_ms") or {}
+            for src in ("p50", "p99"):
+                v = lat.get(src)
+                if isinstance(v, (int, float)):
+                    out[f"latency_ms_{src}@{tier}"] = float(v)
+            for key in ("qps", "error_rate"):
+                v = td.get(key)
+                if isinstance(v, (int, float)):
+                    out[f"{key}@{tier}"] = float(v)
     if "value" in doc and doc.get("unit") == "clips/sec/chip":
         out["clips_per_sec_per_chip"] = float(doc["value"])
     return out
@@ -281,12 +306,12 @@ def check(current: dict, baseline: dict, tolerance: float) -> tuple[bool,
             continue
         compared += 1
         drift = (c - b) / b
-        bad = (drift > tolerance if GATE_DIRECTIONS[name] == "lower"
+        bad = (drift > tolerance if gate_direction(name) == "lower"
                else drift < -tolerance)
         ok = ok and not bad
         lines.append(f"  [{'FAIL' if bad else 'ok'}] {name}: "
                      f"{b:g} -> {c:g} ({drift:+.1%}, "
-                     f"{GATE_DIRECTIONS[name]} is better)")
+                     f"{gate_direction(name)} is better)")
     if compared == 0:
         # every shared metric got skipped (all-zero baseline, e.g. a
         # bench error-path record committed by mistake) — a gate that

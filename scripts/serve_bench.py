@@ -18,6 +18,21 @@ Modes:
 - **open**: queries arrive on a Poisson clock at ``--qps`` regardless of
   completions (the honest SLO view: latency under an offered load that
   does not politely wait for the server).
+- **tiered** (``--tiers interactive:80,batch:200``): one open-loop
+  Poisson driver PER SLO tier, concurrently, each request stamped with
+  its tier — the per-tenant view.  The report gains a ``tiers`` block
+  (per-tier p50/p99, qps, refusal taxonomy, ``error_rate``) and
+  ``obs_report --check`` gates per-tier p99 + error_rate.  ``--knee``
+  sweeps the offered load (doubling per round) and reports each tier's
+  QPS knee — the last load the service cleared inside
+  ``--knee_slo_ms``.
+
+Live-index options: ``--live_index`` serves through the
+generation-swapped ``LiveRetrievalIndex`` and ``--ingest_rows N
+--ingest_interval_s S`` runs a background ingest job (N random rows
+every S seconds through ``service.index_add``), so a chaos spec like
+``--faults 'index.swap_raise@%3'`` exercises swap failures UNDER load.
+``--continuous`` turns on continuous batching (SERVING.md).
 
 Queries are drawn from a ``--distinct``-sized pool with a Zipf-ish
 (1/rank) distribution, so the text-embedding cache sees a realistic
@@ -114,14 +129,23 @@ def build_service(args):
         n = min(top, args.corpus - lo)
         clips = rng.integers(0, 255, (n,) + video_shape, dtype=np.uint8)
         corpus_emb.append(engine.embed_video(clips))
-    index = DeviceRetrievalIndex(
-        mesh, np.concatenate(corpus_emb, axis=0),
-        k=min(args.topk, args.corpus), query_buckets=engine.buckets)
+    corpus_emb = np.concatenate(corpus_emb, axis=0)
+    k = min(args.topk, args.corpus)
+    if args.live_index:
+        from milnce_tpu.serving.live_index import LiveRetrievalIndex
+
+        index = LiveRetrievalIndex(mesh, corpus_emb, k=k,
+                                   query_buckets=engine.buckets,
+                                   registry=registry)
+    else:
+        index = DeviceRetrievalIndex(mesh, corpus_emb, k=k,
+                                     query_buckets=engine.buckets)
     service = RetrievalService(
         engine, index, cache=EmbeddingLRUCache(args.cache_capacity),
         max_delay_ms=args.max_delay_ms,
         default_timeout_ms=args.timeout_ms, registry=registry,
-        max_inflight=args.max_inflight)
+        max_inflight=args.max_inflight, tiers=args.tier_shares,
+        continuous=args.continuous)
     return cfg, service
 
 
@@ -152,11 +176,12 @@ def make_query_draw(cfg, distinct: int):
 
 
 def _make_issue(service, lats: list, counters: dict,
-                lock: threading.Lock):
+                lock: threading.Lock, tier=None):
     """-> ``issue(row)``: one query with the full refusal taxonomy
     counted — expired (504), shed (429), degraded (503) are STRUCTURED
     refusals, ``errors`` is everything unstructured.  Every branch
-    returns; nothing can hang a worker."""
+    returns; nothing can hang a worker.  ``tier`` stamps the request's
+    SLO class (tiered mode)."""
     from milnce_tpu.serving.batcher import DeadlineExpired
     from milnce_tpu.serving.pool import PoolSaturated, PoolUnavailable
     from milnce_tpu.serving.service import DegradedError, ShedError
@@ -164,7 +189,7 @@ def _make_issue(service, lats: list, counters: dict,
     def issue(row) -> None:
         t0 = time.perf_counter()
         try:
-            service.query_ids(row[None, :])
+            service.query_ids(row[None, :], tier=tier)
         except DeadlineExpired:
             with lock:
                 counters["deadline_expired"] += 1
@@ -215,18 +240,14 @@ def run_closed_loop(service, draw, duration: float,
     return lats, counters
 
 
-def run_open_loop(service, draw, duration: float, qps: float):
+def _open_loop_drive(issue, draw, duration: float, qps: float,
+                     seed: int = 11) -> None:
     """Poisson arrivals at ``qps``; each arrival runs on its own thread
     (requests keep arriving whether or not earlier ones finished)."""
     import numpy as np
 
-    lats: list[float] = []
-    counters = new_counters()
-    lock = threading.Lock()
-    issue = _make_issue(service, lats, counters, lock)
-    rng = np.random.default_rng(11)
+    rng = np.random.default_rng(seed)
     inflight: list[threading.Thread] = []
-
     t_end = time.monotonic() + duration
     next_arrival = time.monotonic()
     while time.monotonic() < t_end:
@@ -240,7 +261,131 @@ def run_open_loop(service, draw, duration: float, qps: float):
         inflight.append(t)
     for t in inflight:
         t.join(timeout=30.0)
+
+
+def run_open_loop(service, draw, duration: float, qps: float):
+    lats: list[float] = []
+    counters = new_counters()
+    lock = threading.Lock()
+    _open_loop_drive(_make_issue(service, lats, counters, lock),
+                     draw, duration, qps)
     return lats, counters
+
+
+def run_tiered_open_loop(service, draw, duration: float, tier_qps: dict):
+    """One open-loop Poisson driver per SLO tier, concurrently; returns
+    ``{tier: (lats, counters, qps_offered)}``."""
+    results = {}
+    drivers = []
+    for i, (tier, qps) in enumerate(tier_qps.items()):
+        lats: list[float] = []
+        counters = new_counters()
+        lock = threading.Lock()
+        results[tier] = (lats, counters, qps)
+        issue = _make_issue(service, lats, counters, lock, tier=tier)
+        drivers.append(threading.Thread(
+            target=_open_loop_drive,
+            args=(issue, draw, duration, qps, 100 + i), daemon=True))
+    for t in drivers:
+        t.start()
+    for t in drivers:
+        t.join()
+    return results
+
+
+def parse_tier_qps(spec: str) -> dict:
+    """'interactive:80,batch:200' -> ordered {tier: offered qps}.
+    Duplicate names are an error (same contract as the service's
+    parse_tier_spec) — a typo'd mix must not silently collapse."""
+    out = {}
+    for item in filter(None, (c.strip() for c in spec.split(","))):
+        name, _, qps = item.partition(":")
+        name = name.strip()
+        if not name or not qps or name in out:
+            raise ValueError(f"tier item {item!r}: expected a UNIQUE "
+                             "name:qps")
+        out[name] = float(qps)
+    if not out:
+        raise ValueError("--tiers given but names no tier")
+    return out
+
+
+def knee_from_rounds(rounds: list, slo_ms: float,
+                     min_served_frac: float = 0.9):
+    """The QPS knee from an open-loop sweep: the highest offered load
+    whose round held p99 <= ``slo_ms`` AND served at least
+    ``min_served_frac`` of its offered requests (refusals and errors
+    count against it).  None when even the first round blew through —
+    the knee is below the sweep's floor, a finding in itself."""
+    knee = None
+    for r in rounds:
+        ok = (r["p99_ms"] <= slo_ms
+              and r["served_frac"] >= min_served_frac)
+        if ok and (knee is None or r["qps_offered"] > knee):
+            knee = r["qps_offered"]
+    return knee
+
+
+def _lat_summary(lats: list) -> dict:
+    import numpy as np
+
+    lat_ms = np.asarray(sorted(lats), np.float64) * 1e3
+    pct = (lambda q: float(np.percentile(lat_ms, q))) if len(lat_ms) \
+        else (lambda q: float("nan"))
+    return {
+        "p50": round(pct(50), 3), "p95": round(pct(95), 3),
+        "p99": round(pct(99), 3),
+        "mean": round(float(lat_ms.mean()), 3) if len(lat_ms)
+        else float("nan"),
+        "max": round(float(lat_ms.max()), 3) if len(lat_ms)
+        else float("nan"),
+    }
+
+
+def _tier_block(results: dict, elapsed: float) -> dict:
+    """Per-tier report block: latency summary + refusal taxonomy +
+    the per-tier ``error_rate`` / ``qps`` gate metrics."""
+    out = {}
+    for tier, (lats, counters, offered) in results.items():
+        total = (len(lats) + counters["errors"]
+                 + counters["deadline_expired"] + counters["shed"]
+                 + counters["degraded"])
+        out[tier] = {
+            "qps_offered": offered,
+            "qps": round(len(lats) / elapsed, 2) if elapsed > 0 else 0.0,
+            "requests": len(lats),
+            "latency_ms": _lat_summary(lats),
+            "error_rate": round(counters["errors"] / max(1, total), 5),
+            "served_frac": round(len(lats) / max(1, total), 5),
+            **counters,
+        }
+    return out
+
+
+def start_ingest(service, rows: int, interval_s: float,
+                 stop: threading.Event, seed: int = 99):
+    """Background ingest job: ``rows`` random embedding rows through
+    ``service.index_add`` every ``interval_s`` — the write-path load for
+    live-index benches (ingest errors are counted, never raised into
+    the bench)."""
+    import numpy as np
+
+    counters = {"ingests": 0, "ingest_errors": 0}
+    dim = service.engine.embed_dim
+    rng = np.random.default_rng(seed)
+
+    def loop():
+        while not stop.wait(interval_s):
+            try:
+                service.index_add(embeddings=rng.standard_normal(
+                    (rows, dim)).astype(np.float32))
+                counters["ingests"] += 1
+            except Exception:
+                counters["ingest_errors"] += 1
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return t, counters
 
 
 def main(argv=None) -> int:
@@ -294,6 +439,34 @@ def main(argv=None) -> int:
     ap.add_argument("--max_inflight", type=int, default=0,
                     help="admission bound: rows in flight before requests "
                          "shed with 429 (0 = unbounded)")
+    ap.add_argument("--continuous", action="store_true",
+                    help="continuous batching: flush the instant a "
+                         "dispatch lane is free, accumulate while lanes "
+                         "are busy (SERVING.md; default = flush-and-wait)")
+    ap.add_argument("--live_index", action="store_true",
+                    help="serve through the generation-swapped "
+                         "LiveRetrievalIndex (ingest-capable)")
+    ap.add_argument("--ingest_rows", type=int, default=0,
+                    help="live-index background ingest: rows per ingest "
+                         "(0 = no ingest job; needs --live_index)")
+    ap.add_argument("--ingest_interval_s", type=float, default=0.5,
+                    help="seconds between background ingests")
+    ap.add_argument("--tiers", default="",
+                    help="tiered open-loop mode: 'name:qps[,name:qps...]' "
+                         "— one Poisson driver per SLO tier (overrides "
+                         "--mode; first tier = highest priority)")
+    ap.add_argument("--tier_shares", default="",
+                    help="admission tier spec 'name:share[,...]' "
+                         "(service.parse_tier_spec grammar); '' with "
+                         "--tiers = first tier 1.0, the rest 0.5")
+    ap.add_argument("--knee", action="store_true",
+                    help="with --tiers: sweep offered load (doubling per "
+                         "round) and report each tier's QPS knee")
+    ap.add_argument("--knee_rounds", type=int, default=3,
+                    help="sweep rounds (offered load x1, x2, x4, ...)")
+    ap.add_argument("--knee_slo_ms", type=float, default=500.0,
+                    help="p99 bound a round must hold to count toward "
+                         "the knee")
     ap.add_argument("--faults", default="",
                     help="fault-injection spec (resilience/faults.py "
                          "grammar, e.g. 'serve.dispatch_raise@%%5;"
@@ -317,6 +490,16 @@ def main(argv=None) -> int:
                 f"{args.replicas}").strip()
     import numpy as np
 
+    if args.ingest_rows and not args.live_index:
+        ap.error("--ingest_rows needs --live_index")
+    tier_qps = parse_tier_qps(args.tiers) if args.tiers else None
+    if tier_qps and not args.tier_shares:
+        # default shares: the first (highest-priority) tier may use the
+        # whole admission budget, every later tier half of it
+        args.tier_shares = ",".join(
+            f"{name}:{1.0 if i == 0 else 0.5}"
+            for i, name in enumerate(tier_qps))
+
     t0 = time.monotonic()
     cfg, service = build_service(args)     # includes engine+index warmup
     warmup_s = time.monotonic() - t0
@@ -331,26 +514,67 @@ def main(argv=None) -> int:
         os.environ[faults.ENV_VAR] = args.faults
         faults.arm(args.faults)
 
+    ingest_stop = threading.Event()
+    ingest_counters = None
+    if args.ingest_rows:
+        _ingest_thread, ingest_counters = start_ingest(
+            service, args.ingest_rows, args.ingest_interval_s, ingest_stop)
+
+    tier_results = None
+    knee_report = None
     t_run = time.monotonic()
-    if args.mode == "closed":
+    if tier_qps:
+        rounds_by_tier = {t: [] for t in tier_qps}
+        factors = ([2 ** r for r in range(max(1, args.knee_rounds))]
+                   if args.knee else [1])
+        round_elapsed = args.duration
+        for factor in factors:
+            scaled = {t: q * factor for t, q in tier_qps.items()}
+            t_round = time.monotonic()
+            res = run_tiered_open_loop(service, draw, args.duration,
+                                       scaled)
+            round_elapsed = time.monotonic() - t_round
+            tier_results = res          # the LAST round feeds the report
+            block = _tier_block(res, round_elapsed)
+            for t, td in block.items():
+                rounds_by_tier[t].append({
+                    "qps_offered": td["qps_offered"],
+                    "p99_ms": td["latency_ms"]["p99"],
+                    "served_frac": td["served_frac"]})
+        if args.knee:
+            knee_report = {
+                t: {"knee_qps": knee_from_rounds(rounds, args.knee_slo_ms),
+                    "slo_ms": args.knee_slo_ms, "rounds": rounds}
+                for t, rounds in rounds_by_tier.items()}
+        lats, counters = [], new_counters()
+        for t_lats, t_counters, _ in tier_results.values():
+            lats.extend(t_lats)
+            for key in counters:
+                counters[key] += t_counters[key]
+    elif args.mode == "closed":
         lats, counters = run_closed_loop(
             service, draw, args.duration, args.concurrency)
     else:
         lats, counters = run_open_loop(
             service, draw, args.duration, args.qps)
     elapsed = time.monotonic() - t_run
+    if tier_qps:
+        # lats/counters hold the LAST round only — qps (top-level and
+        # per-tier) must divide by that round's measured window, not the
+        # whole sweep (a --knee run's elapsed spans every round)
+        elapsed = round_elapsed
+    ingest_stop.set()
     errors, expired = counters["errors"], counters["deadline_expired"]
     health = service.health()
     service.close()
+    if args.live_index:
+        service.index.close()
     if args.replicas > 1:
         service.engine.close()
 
-    lat_ms = np.asarray(sorted(lats), np.float64) * 1e3
-    pct = (lambda q: float(np.percentile(lat_ms, q))) if len(lat_ms) else (
-        lambda q: float("nan"))
     extra = {
         "generator": "scripts/serve_bench.py",
-        "mode": args.mode,
+        "mode": "tiers" if tier_qps else args.mode,
         "backend": args.backend,
         "preset": args.preset,
         "config": {k: v for k, v in vars(args).items() if k != "out"},
@@ -372,14 +596,7 @@ def main(argv=None) -> int:
             errors / max(1, len(lats) + errors + expired
                          + counters["shed"] + counters["degraded"]), 5),
         "qps": round(len(lats) / elapsed, 2) if elapsed > 0 else 0.0,
-        "latency_ms": {
-            "p50": round(pct(50), 3), "p95": round(pct(95), 3),
-            "p99": round(pct(99), 3),
-            "mean": round(float(lat_ms.mean()), 3) if len(lat_ms) else
-            float("nan"),
-            "max": round(float(lat_ms.max()), 3) if len(lat_ms) else
-            float("nan"),
-        },
+        "latency_ms": _lat_summary(lats),
         "batch_occupancy": health["batcher"]["occupancy"],
         "batcher": {k: v for k, v in health["batcher"].items()
                     if k != "occupancy"},
@@ -389,6 +606,22 @@ def main(argv=None) -> int:
         "admission": health["admission"],
         "pool": health.get("pool"),
     }
+    if tier_results is not None:
+        # per-tier gate metrics: obs_report reads latency_ms_p99@<tier>
+        # and error_rate@<tier> out of this block
+        extra["tiers"] = _tier_block(tier_results, elapsed)
+    if knee_report is not None:
+        extra["knee"] = knee_report
+    if ingest_counters is not None:
+        idx_stats = health["index"]
+        extra["ingest"] = {
+            **ingest_counters,
+            "generation": idx_stats.get("generation"),
+            "swaps": idx_stats.get("swaps"),
+            "swap_failures": idx_stats.get("swap_failures"),
+            "pending_rows": idx_stats.get("pending_rows"),
+            "corpus_size": idx_stats.get("size"),
+        }
     # the versioned obs snapshot (OBSERVABILITY.md): registry metrics
     # (request counters, per-bucket occupancy, collect-time gauges) plus
     # the report keys above as extras — SERVE_BENCH_*.json and train
@@ -403,7 +636,8 @@ def main(argv=None) -> int:
                                  run_id=auto_run_id("sbench-"),
                                  process_index=0)
     out = args.out or os.path.join(
-        _REPO, f"SERVE_BENCH_{args.preset}_{args.mode}.json")
+        _REPO, f"SERVE_BENCH_{args.preset}_"
+               f"{'tiers' if tier_qps else args.mode}.json")
     with open(out, "w") as fh:
         json.dump(report, fh, indent=2, sort_keys=True)
     res = report["resilience"]
@@ -416,7 +650,26 @@ def main(argv=None) -> int:
           f"requeued={res.get('requeued', 0)} hedged={res.get('hedged', 0)} "
           f"quarantines={res.get('quarantines', 0)}, "
           f"recompiles={report['engine']['recompiles']} -> {out}")
-    return 0 if report["engine"]["recompiles"] in (0, -1) else 1
+    if report.get("tiers"):
+        for t, td in report["tiers"].items():
+            print(f"  tier {t}: offered {td['qps_offered']} qps, served "
+                  f"{td['qps']} qps, p50={td['latency_ms']['p50']}ms "
+                  f"p99={td['latency_ms']['p99']}ms, shed={td['shed']} "
+                  f"errors={td['errors']} error_rate={td['error_rate']}")
+    if report.get("knee"):
+        for t, kd in report["knee"].items():
+            print(f"  knee {t}: {kd['knee_qps']} qps @ p99<="
+                  f"{kd['slo_ms']}ms ({len(kd['rounds'])} rounds)")
+    if report.get("ingest"):
+        ing = report["ingest"]
+        print(f"  ingest: {ing['ingests']} ingests -> generation "
+              f"{ing['generation']} ({ing['corpus_size']} rows live, "
+              f"{ing['swaps']} swaps, {ing['swap_failures']} swap "
+              f"failures, {ing['pending_rows']} pending)")
+    index_recompiles = (report["index"] or {}).get("recompiles", 0)
+    ok = (report["engine"]["recompiles"] in (0, -1)
+          and index_recompiles in (0, -1, None))
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

@@ -320,6 +320,9 @@ def test_all_registered_entries_plan_green():
             "serve_text_embed@b0", "serve_text_embed@b1",
             "serve_video_embed@b0", "serve_video_embed@b1",
             "serve_index_topk",
+            # ISSUE 14: the live index's generation program at its
+            # capacity rung
+            "serve_index_topk@gen",
             "train_step_milnce_instrumented"} <= entries
     # every grad-bearing entry carries all three rule checks + TPU gate
     checks = {(r.entry, r.check) for r in results}

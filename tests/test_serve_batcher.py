@@ -230,6 +230,123 @@ def test_stats_readers_race_flushes_with_exact_final_occupancy():
                for occ in s["occupancy"].values()) == s["flushes"]
 
 
+def test_continuous_lone_request_skips_the_delay_wait():
+    """Continuous batching (ISSUE 14): a lone request flushes the
+    moment the lane is free — it never pays max_delay_ms waiting for
+    company that isn't coming (the flush-and-wait path's cost)."""
+    eng = _FakeEngine()
+    b = _mk(eng, max_delay_ms=10_000, continuous=True)
+    t0 = time.monotonic()
+    row = b.submit(np.ones((3,), np.float32)).result(timeout=5)
+    waited = time.monotonic() - t0
+    assert np.array_equal(row, np.full((3,), 2.0))
+    assert waited < 2.0, f"continuous mode waited {waited:.3f}s"
+    b.close()
+
+
+def test_continuous_accumulates_into_bucket_slots_while_lane_busy():
+    """While the single lane executes, arrivals accumulate into the
+    forming batch — occupancy rises exactly when the device is the
+    bottleneck (the slot-reuse win over flush-and-wait)."""
+    eng = _FakeEngine(delay_s=0.15)
+    b = _mk(eng, continuous=True)                    # max_batch 8
+    futs = [b.submit(np.full((3,), 0.0, np.float32))]
+    time.sleep(0.03)                 # first flush (1 row) is in flight
+    futs += [b.submit(np.full((3,), float(i), np.float32))
+             for i in range(1, 7)]
+    for f in futs:
+        f.result(timeout=5)
+    b.close()
+    sizes = [batch.shape[0] for batch in eng.batches]
+    assert sizes == [4, 8], (
+        f"expected the 6 lane-busy arrivals to coalesce: {sizes}")
+
+
+def test_continuous_deadline_expires_promptly_while_lane_busy():
+    """Pipelined continuous mode: a request aging out while the worker
+    is PARKED on a busy lane fails with DeadlineExpired at the
+    lane-wait tick — it never waits for the lane to free first."""
+    from concurrent.futures import Future
+
+    slow: list[Future] = []
+
+    def run_async(rows):
+        fut: Future = Future()
+        slow.append(fut)
+        return fut                        # resolved manually, late
+
+    b = _mk(_FakeEngine(), continuous=True, lanes=1,
+            run_batch_async=run_async)
+    blocker = b.submit(np.ones((3,), np.float32))     # occupies the lane
+    deadline = time.monotonic() + 5.0
+    while not slow and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert slow, "the blocker batch never dispatched"
+    doomed = b.submit(np.zeros((3,), np.float32), timeout_ms=60)
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExpired):
+        doomed.result(timeout=5)
+    waited = time.monotonic() - t0
+    assert waited < 0.4, (f"expiry took {waited:.3f}s — waited for the "
+                          "lane instead of the deadline")
+    slow[0].set_result(np.ones((4, 3), np.float32) * 2.0)
+    assert np.array_equal(blocker.result(timeout=5), np.full((3,), 2.0))
+    assert b.stats()["deadline_expired"] == 1
+    b.close()
+
+
+def test_continuous_async_lanes_bound_inflight_batches():
+    """Pipelined continuous mode: at most ``lanes`` batches are ever in
+    flight at once (the semaphore), and every batch still resolves."""
+    from concurrent.futures import Future
+
+    inflight = {"now": 0, "max": 0}
+    lock = threading.Lock()
+    pending: list[tuple] = []
+
+    def run_async(rows):
+        fut: Future = Future()
+        with lock:
+            inflight["now"] += 1
+            inflight["max"] = max(inflight["max"], inflight["now"])
+            pending.append((fut, np.array(rows, copy=True)))
+        return fut
+
+    def resolver():
+        while not stop.is_set():
+            with lock:
+                item = pending.pop(0) if pending else None
+            if item is None:
+                time.sleep(0.005)
+                continue
+            time.sleep(0.05)                  # the "dispatch"
+            fut, rows = item
+            with lock:
+                inflight["now"] -= 1
+            fut.set_result(rows * 2.0)
+
+    stop = threading.Event()
+    t = threading.Thread(target=resolver, daemon=True)
+    t.start()
+    b = _mk(_FakeEngine(), continuous=True, lanes=2,
+            run_batch_async=run_async)
+    try:
+        futs = []
+        for burst in range(6):                # 6 bursts of 2 rows
+            futs += [b.submit(np.full((3,), float(burst), np.float32))
+                     for _ in range(2)]
+            time.sleep(0.02)
+        out = [f.result(timeout=10) for f in futs]
+        assert all(o.shape == (3,) for o in out)
+        assert inflight["max"] <= 2, (
+            f"{inflight['max']} batches in flight > 2 lanes")
+        assert inflight["max"] >= 2, "lanes never actually pipelined"
+    finally:
+        stop.set()
+        b.close()
+        t.join(timeout=5)
+
+
 def test_injected_recorder_receives_flush_spans():
     # an owner that isolates its span stream (recorder=...) must get the
     # flush spans there — not on the process-default recorder, which a

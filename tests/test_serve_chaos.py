@@ -391,9 +391,9 @@ class TestAdmission:
             seen = []
             real_admit = service._admission.admit
 
-            def spying_admit(rows, timeout_ms):
+            def spying_admit(rows, timeout_ms, tier=None):
                 seen.append(timeout_ms)
-                return real_admit(rows, timeout_ms)
+                return real_admit(rows, timeout_ms, tier)
 
             service._admission.admit = spying_admit
             service.embed_text_ids(_rows(1))

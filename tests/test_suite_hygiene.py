@@ -27,7 +27,8 @@ _TESTS = os.path.join(_REPO, "tests")
 # explicit, reasoned exemption below rather than an invisible spawn.
 _EXPENSIVE_FRAGMENTS = ("bench.py", "stage_probe.py", "xla_flag_probe.py",
                         "milnce_loss_bench.py", "real_train_eval.py",
-                        "._run_config(", "lockrt_hammer_child.py")
+                        "._run_config(", "lockrt_hammer_child.py",
+                        "live_index_hammer_child.py")
 
 # audited exceptions: child-process tests that are seconds-scale by
 # construction and REQUIRED tier-1 by their ISSUE (a fresh interpreter +
@@ -52,6 +53,19 @@ _FAST_CHILD_EXEMPT = {
     # cache keep it seconds-scale, and the serving-chaos gate pins it
     # tier-1.
     "test_serve_chaos.py::test_chaos_serve_bench_closed_loop_acceptance",
+    # ISSUE 14 satellite: the 16-thread ingest-while-query hammer under
+    # MILNCE_LOCK_SANITIZE=1 — a subprocess because the sanitizer must
+    # be armed BEFORE the serving modules import; tiny dims (16-wide
+    # embeddings, no model) keep it seconds-scale, and the live-index
+    # gate pins it tier-1.
+    "test_live_index.py::test_live_index_hammer_subprocess_under_sanitizer",
+    # ISSUE 14 acceptance: the two-tier chaos bench (interactive +
+    # batch backfill with live-index ingest under index.swap_raise@%3,
+    # continuous batching on) gated via obs_report --check.  A
+    # subprocess because the acceptance pin IS the real script + gate
+    # end-to-end; tiny preset + the shared persistent compile cache
+    # keep it seconds-scale, and the live-index gate pins it tier-1.
+    "test_serve_tiers.py::test_two_tier_chaos_bench_acceptance",
 }
 
 
@@ -319,6 +333,29 @@ def test_memloss_gates_exist_and_stay_tier1():
         assert not slow, (
             "chunked MIL-NCE tests must be tier-1/CPU-safe, never @slow "
             "(they are the memory-efficient-loss regression fence): "
+            f"{fname}::{slow}")
+
+
+# live-index + SLO-tier gates (ISSUE 14): the generation-swap parity
+# pin, swap-failure chaos, snapshot round trip, the ingest-while-query
+# hammer, the tier admission units and the two-tier chaos bench are the
+# regression fence for the online-ingest serving path.  Same rule as
+# every other subsystem gate: tier-1, never @slow, never vanished.
+_LIVE_INDEX_GATES = ("test_live_index.py", "test_serve_tiers.py")
+
+
+def test_live_index_gates_exist_and_stay_tier1():
+    for fname in _LIVE_INDEX_GATES:
+        path = os.path.join(_TESTS, fname)
+        assert os.path.exists(path), f"live-index gate {fname} is missing"
+        src = open(path).read()
+        tests = list(_iter_tests(ast.parse(src)))
+        assert tests, f"{fname} defines no tests"
+        slow = [node.name for node, class_slow in tests
+                if _is_slow_marked(node, class_slow)]
+        assert not slow, (
+            "live-index tests must be tier-1/CPU-safe, never @slow "
+            "(they are the online-ingest regression fence): "
             f"{fname}::{slow}")
 
 

@@ -43,7 +43,10 @@ def test_all_registered_entry_invariants_hold():
             # ISSUE 10: pooled serving — per-replica ladder recompile pin
             # + collective-free replica embed programs
             "serve_pool_embed", "serve_pool_text_embed",
-            "serve_pool_video_embed"} <= entries
+            "serve_pool_video_embed",
+            # ISSUE 14: generation-swapped live index — same pinned
+            # program + zero query-path recompiles across swaps
+            "serve_live_index"} <= entries
     # the double-call recompile detector ran on every executable entry
     recompiled = {r.entry for r in results if r.check == "recompile"}
     assert {"train_step_milnce", "train_step_milnce_guarded",
@@ -57,6 +60,8 @@ def test_all_registered_entry_invariants_hold():
     assert ("train_step_milnce_instrumented", "transfer-guard") in checks
     assert ("train_step_milnce_instrumented",
             "identical-to-uninstrumented") in checks
+    # ISSUE 14 tentpole pin: swaps never compile on the query path
+    assert ("serve_live_index", "recompile-across-swaps") in checks
 
 
 def test_f64_detector_catches_planted_upcast():
