@@ -28,6 +28,17 @@ point.  Every record carries its mesh shape and sharding-map hash so
 map shards nothing is REFUSED rather than measured as fake FSDP.
 Related knobs: MILNCE_BENCH_FSDP_MIN (threshold override),
 MILNCE_BENCH_MESH_2D=0 (skip the comparison row).
+
+Curriculum axis (ISSUE 16): ``MILNCE_BENCH_CURRICULUM=<train.curriculum
+spec>`` measures every stage of a staged-resolution schedule as its own
+row (stage shape, winning dtype) and reports the whole-schedule
+clips/sec against a flat full-res run of the same total clip count —
+the measured answer to "what does the curriculum buy".  Stages must be
+``until_step``-bounded; the open-ended final stage defaults to the
+bounded stages' total steps (override:
+MILNCE_BENCH_CURRICULUM_STEPS).  Stage rows ride in the record under
+``curriculum`` and in BENCH_NOTES.md with a ``stage`` column; they
+never displace the headline sweep measurement.
 """
 
 from __future__ import annotations
@@ -795,19 +806,25 @@ def run_bench(on_tpu: bool, info: dict):
 
     def measure(dtype, batch, remat, s2d, conv_impl, loss="milnce",
                 grad_accum=1, timeout_s=None, conv_impl_map=None,
-                mesh=None, impl=None):
+                mesh=None, impl=None, frames_=None, size_=None):
+        # frames_/size_ override the sweep's fixed input shape (the
+        # curriculum stage rows); hint() seeds are keyed per-shape
+        # implicitly (one sweep shape), so off-shape rows skip the hint
+        off_shape = frames_ is not None or size_ is not None
         return _run_config(
             timeout_s=timeout_s or cfg_timeout,
             platform_pin=None if on_tpu else "cpu",
-            dtype=dtype, batch=batch, frames=frames,
-            size=size, words=words, k=k, remat=remat,
+            dtype=dtype, batch=batch,
+            frames=frames if frames_ is None else frames_,
+            size=size if size_ is None else size_, words=words, k=k,
+            remat=remat,
             inner=1 if grad_accum > 1 else inner, s2d=s2d,
             conv_impl=conv_impl,
             conv_impl_map=impl_map if conv_impl_map is None else conv_impl_map,
             loss=loss, grad_accum=grad_accum,
             mesh_spec=mesh_spec if mesh is None else mesh,
             loss_impl=loss_impl if impl is None else impl, peak=peak,
-            flops_hint=None if grad_accum > 1
+            flops_hint=None if grad_accum > 1 or off_shape
             else hint(dtype, remat, s2d, batch))
 
     def tunnel_wedged(exc) -> bool:
@@ -922,6 +939,7 @@ def run_bench(on_tpu: bool, info: dict):
             pool = [x for x in results
                     if x.get("loss", "milnce") == "milnce"
                     and x.get("grad_accum", 1) == 1
+                    and x.get("stage") is None
                     and (loss_impl == "chunked"
                          or x.get("loss_impl") in (None, "dense"))]
             if pool:    # empty = every auto row resolved chunked; keep
@@ -1000,9 +1018,94 @@ def run_bench(on_tpu: bool, info: dict):
                   s2d=False, conv_impl="native", conv_impl_map="",
                   timeout_s=2 * cfg_timeout)
 
+    # Curriculum axis (ISSUE 16): MILNCE_BENCH_CURRICULUM holds a
+    # train.curriculum spec — each stage is measured as its own row at
+    # the stage's (frames, resolution, batch) on the winning dtype, and
+    # the whole-schedule rate (steps-weighted composition of the
+    # per-stage rates) is compared against running the SAME total clip
+    # count flat at the final stage's full-res shape.  Stage rows carry
+    # ``stage``/``stage_label`` and never enter the headline pool:
+    # different input shapes are not comparable operating points.
+    curriculum_spec = os.environ.get("MILNCE_BENCH_CURRICULUM", "")
+    curriculum_summary = None
+    if curriculum_spec and not dead:
+        try:
+            # jax-free at module scope (the orchestrator must not hold
+            # a backend) — same parser the train loop uses, so the axis
+            # refuses exactly the specs run_training would refuse
+            from milnce_tpu.train.curriculum import parse_curriculum
+
+            stages = parse_curriculum(curriculum_spec,
+                                      default_batch_size=best["batch"])
+            # per-stage step counts from the until_step boundaries.  The
+            # bench axis requires step-bounded stages (epoch bounds need
+            # a dataset size a synthetic bench doesn't have); the
+            # open-ended final stage defaults to the bounded stages'
+            # total (override: MILNCE_BENCH_CURRICULUM_STEPS).
+            stage_steps, prev_bound = [], 0
+            for i, st in enumerate(stages[:-1]):
+                if st.until_step is None:
+                    raise ValueError(
+                        f"bench curriculum stage {i} must be bounded by "
+                        "until_step — epoch bounds need a dataset size")
+                stage_steps.append(st.until_step - prev_bound)
+                prev_bound = st.until_step
+            final_steps = int(os.environ.get(
+                "MILNCE_BENCH_CURRICULUM_STEPS", "0"))
+            stage_steps.append(final_steps or sum(stage_steps) or 1000)
+            stage_rows = []
+            for i, (st, n_steps) in enumerate(zip(stages, stage_steps)):
+                r = measure(best["dtype"], st.batch_size, best["remat"],
+                            False, "native", conv_impl_map="",
+                            frames_=st.num_frames, size_=st.resolution)
+                r["stage"] = i
+                r["stage_label"] = st.label()
+                r["stage_steps"] = n_steps
+                _note(f"bench: {r}")
+                results.append(r)
+                stage_rows.append(r)
+            total_clips = sum(r["stage_steps"] * r["batch"]
+                              for r in stage_rows)
+            # chip-seconds per chip of the whole schedule: each stage
+            # contributes steps*batch clips at its own per-chip rate
+            sched_time = sum(r["stage_steps"] * r["batch"]
+                             / r["clips_per_sec_per_chip"]
+                             for r in stage_rows)
+            schedule_cps = total_clips / sched_time
+            flat_cps = stage_rows[-1]["clips_per_sec_per_chip"]
+            curriculum_summary = {
+                "spec": curriculum_spec,
+                "stages": [{
+                    "stage": r["stage"], "label": r["stage_label"],
+                    "steps": r["stage_steps"], "batch": r["batch"],
+                    "step_ms": r["step_ms"],
+                    "clips_per_sec_per_chip": r["clips_per_sec_per_chip"],
+                } for r in stage_rows],
+                "total_clips": total_clips,
+                "schedule_clips_per_sec_per_chip": round(schedule_cps, 3),
+                "flat_clips_per_sec_per_chip": flat_cps,
+                # flat comparator = the final stage's full-res rate over
+                # the same clip COUNT (a throughput comparison — the
+                # learning-curve question is PERF.md's, not bench's)
+                "speedup_vs_flat": round(schedule_cps / flat_cps, 3),
+            }
+            _note(f"bench: curriculum schedule "
+                  f"{schedule_cps:.2f} clips/s/chip vs flat {flat_cps} "
+                  f"at {total_clips} total clips "
+                  f"({curriculum_summary['speedup_vs_flat']}x)")
+        except Exception as exc:
+            dead = tunnel_wedged(exc)
+            _note(f"bench: curriculum axis failed "
+                  f"({type(exc).__name__}: {exc}) — keeping prior results")
+
     _write_notes(results, best, kind, on_tpu, n_devices,
-                 truncated=dead)
+                 truncated=dead, curriculum=curriculum_summary)
     final = _make_record(best, frames, size, on_tpu, kind)
+    if curriculum_summary:
+        # attached to the headline record, never emitted as its own
+        # final line: consumers take the LAST parsable record, and a
+        # stage-shaped row must not displace the sweep's measurement
+        final["curriculum"] = curriculum_summary
     if dead:
         # machine-visible truncation: rows measured before the tunnel
         # died must not read as a complete sweep (the orchestrator still
@@ -1011,7 +1114,8 @@ def run_bench(on_tpu: bool, info: dict):
     return final
 
 
-def _write_notes(results, best, kind, on_tpu, n_chips, truncated=False):
+def _write_notes(results, best, kind, on_tpu, n_chips, truncated=False,
+                 curriculum=None):
     notes = os.path.join(_REPO, "BENCH_NOTES.md")
     hand_notes = ""
     if os.path.exists(notes):
@@ -1033,8 +1137,8 @@ def _write_notes(results, best, kind, on_tpu, n_chips, truncated=False):
                  f"- chosen operating point: dtype={best['dtype']} "
                  f"batch={best['batch']} remat={best['remat']} -> "
                  f"{best['clips_per_sec_per_chip']} clips/sec/chip",
-                 "", "| dtype | batch | remat | s2d | conv | map | loss | ga | mesh | step_ms | clips/s/chip | MFU |",
-                 "|---|---|---|---|---|---|---|---|---|---|---|---|"]
+                 "", "| dtype | batch | remat | s2d | conv | map | loss | ga | mesh | stage | step_ms | clips/s/chip | MFU |",
+                 "|---|---|---|---|---|---|---|---|---|---|---|---|---|"]
         for r in results:
             clips = str(r["clips_per_sec_per_chip"])
             if r.get("cliff_vs_smaller_batch"):
@@ -1043,6 +1147,8 @@ def _write_notes(results, best, kind, on_tpu, n_chips, truncated=False):
             loss_lbl = r.get("loss", "milnce")
             if r.get("loss_impl") not in (None, "dense"):
                 loss_lbl += f"({r['loss_impl']})"      # streaming MIL-NCE
+            stage_lbl = ("-" if r.get("stage") is None
+                         else f"{r['stage']} ({r.get('stage_label', '?')})")
             lines.append(f"| {r['dtype']} | {r['batch']} | {r['remat']} | "
                          f"{r.get('s2d', False)} | "
                          f"{r.get('conv_impl', 'native')} | "
@@ -1050,6 +1156,7 @@ def _write_notes(results, best, kind, on_tpu, n_chips, truncated=False):
                          f"{loss_lbl} | "
                          f"{r.get('grad_accum', 1)} | "
                          f"{r.get('mesh', '-')} | "
+                         f"{stage_lbl} | "
                          f"{r['step_ms']} | {clips} | "
                          f"{r.get('mfu', '-')} |")
         maps2d = sorted({r["sharding_map_hash"] for r in results
@@ -1069,6 +1176,21 @@ def _write_notes(results, best, kind, on_tpu, n_chips, truncated=False):
                       "SMALLER batch — a padded-batch/tiling cliff, not "
                       "the usual diminishing-returns knee (PERF.md "
                       "'Batch cliffs')."]
+        if curriculum:
+            lines += ["", "## Curriculum schedule", "",
+                      f"- spec: `{curriculum['spec']}`",
+                      f"- whole-schedule rate: "
+                      f"{curriculum['schedule_clips_per_sec_per_chip']} "
+                      "clips/sec/chip vs flat full-res "
+                      f"{curriculum['flat_clips_per_sec_per_chip']} at "
+                      f"equal total clips ({curriculum['total_clips']}) "
+                      f"-> **{curriculum['speedup_vs_flat']}x**",
+                      "- throughput-equal comparison only: same clip "
+                      "count, not necessarily the same learning curve "
+                      "(PERF.md 'Curriculum training'); stage rows above "
+                      "carry their per-stage shapes in the `stage` "
+                      "column and are excluded from the headline "
+                      "operating point."]
         if truncated:
             lines += ["", "**SWEEP TRUNCATED**: the TPU tunnel wedged "
                       "mid-sweep; rows above are what was measured "

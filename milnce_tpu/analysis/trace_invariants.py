@@ -70,6 +70,15 @@ EXPECTED_COLLECTIVES = {
     # and the entry also EXECUTES it under transfer_guard("disallow")
     "train_step_milnce_instrumented": {"all_gather": 2, "psum": 26,
                                        "reduce_scatter": 2},
+    # curriculum step (ISSUE 16): ONE step_fn serves every stage; each
+    # stage's (frames, resolution, batch) shape is its own jit entry,
+    # compiled once at stage entry.  The invariant is twofold: every
+    # stage's traced program carries the SAME collective multiset as the
+    # single-stage step (shapes scale tensors, never communication
+    # structure), and within a stage the cache never grows (zero
+    # recompiles; entering stage 2 adds exactly one entry)
+    "train_step_curriculum": {"all_gather": 2, "psum": 26,
+                              "reduce_scatter": 2},
     # chunked MIL-NCE (ISSUE 12): the streaming loss must keep the DENSE
     # step's exact communication structure — the same 2 negative
     # all_gathers (whose AD transposes stay the same 2 reduce_scatters)
@@ -331,6 +340,64 @@ def _entry_train_step_milnce_guarded() -> list[CheckResult]:
     out = _jaxpr_checks(name, step, (state,) + batch())
     out.append(_recompile_check(name, step,
                                 lambda s: (state,) + batch(s)))
+    return out
+
+
+def _entry_train_step_curriculum() -> list[CheckResult]:
+    """ISSUE 16: the per-stage re-traced curriculum step.  Two stage
+    shapes (4f and 8f at the tiny size) through ONE step_fn:
+
+    - collectives: both stages' traced programs must match the pinned
+      single-stage multiset — a curriculum changes tensor shapes, never
+      communication structure;
+    - one-entry-per-stage: two same-shape calls per stage, cache size
+      must go 1 -> 2 across the boundary (zero recompiles WITHIN a
+      stage, exactly one fresh jit entry per stage entered — the
+      runtime guarantee train/loop.py's boundary relies on)."""
+    import jax
+    import numpy as np
+
+    from milnce_tpu.train.step import make_train_step
+
+    model, opt, mesh, state, batch = _setup()
+    step = make_train_step(model, opt, mesh, donate=False)
+    name = "train_step_curriculum"
+    ndev = len(jax.devices())
+    b = 2 * ndev
+
+    def stage_batch(frames: int, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        video = rng.integers(0, 255, (b, frames, _SIZE, _SIZE, 3),
+                             dtype=np.uint8)
+        text = rng.integers(0, _TINY["vocab_size"], (b, _WORDS)).astype(
+            np.int32)
+        return video, text, np.zeros((b,), np.float32)
+
+    out = _jaxpr_checks(name, step, (state,) + stage_batch(_FRAMES))
+    got2 = collective_counts(
+        jax.make_jaxpr(step)(state, *stage_batch(2 * _FRAMES)).jaxpr)
+    want = EXPECTED_COLLECTIVES[name]
+    out.append(CheckResult(
+        name, "collectives-stage2", got2 == want,
+        "" if got2 == want else
+        f"stage-2 shape traced {got2}, expected {want} — a stage "
+        "boundary changed the step's communication structure"))
+    if hasattr(step, "_cache_size"):
+        step(state, *stage_batch(_FRAMES, 0))
+        step(state, *stage_batch(_FRAMES, 1))
+        n1 = step._cache_size()
+        step(state, *stage_batch(2 * _FRAMES, 0))
+        step(state, *stage_batch(2 * _FRAMES, 1))
+        n2 = step._cache_size()
+        ok = n1 == 1 and n2 == 2
+        out.append(CheckResult(
+            name, "one-entry-per-stage", ok,
+            "" if ok else f"cache sizes {n1} -> {n2} across two stages; "
+            "expected 1 -> 2 (one jit entry per stage, zero recompiles "
+            "within a stage)"))
+    else:
+        out.append(CheckResult(name, "one-entry-per-stage", True,
+                               "skipped: no _cache_size on this jax"))
     return out
 
 
@@ -902,6 +969,7 @@ ENTRY_POINTS = {
     "train_step_milnce": _entry_train_step_milnce,
     "train_step_milnce_guarded": _entry_train_step_milnce_guarded,
     "train_step_milnce_instrumented": _entry_train_step_milnce_instrumented,
+    "train_step_curriculum": _entry_train_step_curriculum,
     "train_step_sdtw3": _entry_train_step_sdtw3,
     "grad_cache_step_milnce": _entry_grad_cache_step,
     "train_step_milnce_2d": _entry_train_step_2d,

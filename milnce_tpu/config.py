@@ -355,6 +355,16 @@ class TrainConfig:
                                         # well inside the preemption grace
                                         # window (e.g. 300ms steps + 30s grace
                                         # -> N<=50; multi-second steps -> N<=5).
+    curriculum: str = ""                # staged (frames, resolution, batch)
+                                        # training schedule — ordered
+                                        # 'num_frames=4,resolution=64,
+                                        # until_step=1000;...' stages (or a
+                                        # JSON artifact path); final stage
+                                        # open-ended.  '' = flat run.
+                                        # Grammar, plan semantics and the
+                                        # per-stage mem_plan pre-flight:
+                                        # train/curriculum.py + PERF.md
+                                        # "Curriculum training"
 
 
 @dataclass

@@ -15,6 +15,11 @@ a ledger that partitions the run's wall clock into:
 | ``compile``    | the FIRST ``step`` span per process — dispatch     |
 |                | blocks on trace+compile there, and calling that    |
 |                | compute would flatter every short run's goodput    |
+| ``stage_switch`` | curriculum boundaries: each ``stage.switch``     |
+|                | span (prefetcher drain + pipeline rebuild) plus    |
+|                | the first ``step`` span after it — that dispatch   |
+|                | blocks on the new stage's trace+compile, so the    |
+|                | curriculum's overhead is measured, not guessed     |
 | ``data_wait``  | ``data.wait`` spans (device_prefetch pulls: host   |
 |                | blocked assembling/decoding the next batch)        |
 | ``checkpoint`` | ``ckpt.save`` + ``ckpt.restore`` spans             |
@@ -47,8 +52,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-CATEGORIES = ("compute", "compile", "data_wait", "checkpoint",
-              "skipped", "rollback_lost", "unattributed")
+CATEGORIES = ("compute", "compile", "stage_switch", "data_wait",
+              "checkpoint", "skipped", "rollback_lost", "unattributed")
 
 # span name -> raw bucket (before the skipped/rollback reattribution)
 _SPAN_BUCKETS = {
@@ -57,6 +62,7 @@ _SPAN_BUCKETS = {
     "data.wait": "data_wait",
     "ckpt.save": "checkpoint",
     "ckpt.restore": "checkpoint",
+    "stage.switch": "stage_switch",
 }
 
 
@@ -73,6 +79,7 @@ class GoodputLedger:
     decode_timeouts: int = 0
     anomalies: int = 0
     captures: int = 0
+    stage_switches: int = 0     # curriculum boundaries crossed
 
     @property
     def goodput_fraction(self) -> float:
@@ -95,6 +102,7 @@ class GoodputLedger:
             "decode_timeouts": self.decode_timeouts,
             "anomalies": self.anomalies,
             "captures": self.captures,
+            "stage_switches": self.stage_switches,
         }
 
     def summary_line(self) -> str:
@@ -186,7 +194,9 @@ def compute_ledger(records: list, run_id: str | None = None,
     anomalies = 0
     captures = 0
     timeouts = 0
+    stage_switches = 0
     seen_first_step = False
+    pending_switch = False
     for rec in records:
         name = rec.get("name", "")
         if rec.get("kind") == "span":
@@ -198,6 +208,13 @@ def compute_ledger(records: list, run_id: str | None = None,
                     # category, or a 2-step CPU run reads as 95% compute
                     seen_first_step = True
                     cats["compile"] += dur
+                elif pending_switch:
+                    # first step of a NEW curriculum stage: dispatch
+                    # blocks on that stage's trace+compile — boundary
+                    # cost, not steady-state compute (and excluded from
+                    # the mean-step-time pool like the compile step)
+                    pending_switch = False
+                    cats["stage_switch"] += dur
                 else:
                     step_durs.append(dur)
                     cats["compute"] += dur
@@ -205,6 +222,9 @@ def compute_ledger(records: list, run_id: str | None = None,
                 bucket = _SPAN_BUCKETS.get(name)
                 if bucket is not None:
                     cats[bucket] += dur
+                if name == "stage.switch":
+                    stage_switches += 1
+                    pending_switch = True
         elif rec.get("kind") == "event":
             if name == "display":
                 skipped = max(skipped,
@@ -224,7 +244,9 @@ def compute_ledger(records: list, run_id: str | None = None,
     # fraction / mean post-compile step time — the stream doesn't say
     # WHICH steps skipped (that would cost a per-step host sync), and a
     # ledger needs totals, not per-step labels.
-    post_compile = max(1, steps - 1)
+    # compute-pool step count: total minus the compile step and the
+    # per-switch compile steps already attributed to stage_switch
+    post_compile = max(1, steps - 1 - stage_switches)
     if skipped and cats["compute"] > 0:
         frac = min(1.0, skipped / post_compile)
         moved = cats["compute"] * frac
@@ -249,7 +271,7 @@ def compute_ledger(records: list, run_id: str | None = None,
                          skipped_steps=skipped, rollbacks=rollbacks,
                          lost_updates=lost_updates,
                          decode_timeouts=timeouts, anomalies=anomalies,
-                         captures=captures)
+                         captures=captures, stage_switches=stage_switches)
 
 
 def ledger_to_registry(ledger: GoodputLedger, registry) -> None:

@@ -39,8 +39,10 @@ from milnce_tpu.parallel.mesh import (broadcast_str, build_mesh,
                                       initialize_distributed,
                                       replicate_to_mesh)
 from milnce_tpu.resilience import faults
+from milnce_tpu.train import curriculum
 from milnce_tpu.train.checkpoint import CheckpointManager
-from milnce_tpu.train.schedule import build_host_schedule, build_schedule
+from milnce_tpu.train.schedule import (build_host_schedule_total,
+                                       build_schedule_total)
 from milnce_tpu.train.state import TrainState, build_optimizer, create_train_state
 from milnce_tpu.train.step import make_train_step
 from milnce_tpu.utils.logging import RunLogger
@@ -63,7 +65,12 @@ def resume_batch_offset(restored_step: int, steps_per_epoch: int) -> int:
     """Mid-epoch resume position: how many global batches of the current
     epoch the restored step counter has already consumed (an end-of-epoch
     save lands on the boundary -> 0).  Only valid while steps_per_epoch
-    matches the run being resumed."""
+    matches the run being resumed.
+
+    Flat-run reference semantics only: run_training itself derives the
+    offset from the curriculum plan's ``locate`` (train/curriculum.py),
+    which reduces to exactly this modulo for a single-stage plan — the
+    equivalence is pinned by tests/test_curriculum.py."""
     return int(restored_step) % steps_per_epoch
 
 
@@ -77,6 +84,14 @@ def stop_save_label(epoch: int, opt_step: int,
     save — the previous epoch's boundary save holds the same label and
     Orbax would otherwise silently skip it, dropping the partial epoch."""
     done = opt_step % steps_per_epoch == 0
+    return (epoch + 1 if done else epoch), (not done)
+
+
+def stop_save_label_planned(epoch: int, opt_step: int, plan) -> tuple:
+    """Plan-aware twin of :func:`stop_save_label`: per-stage batch sizes
+    make the epoch boundary a plan lookup, not a modulo.  Identical to
+    the flat helper for single-stage plans (tests/test_curriculum.py)."""
+    done = opt_step == plan.epoch_end_step(epoch)
     return (epoch + 1 if done else epoch), (not done)
 
 
@@ -108,9 +123,9 @@ _guard_acc_j = jax.jit(_guard_acc)
 def _fetch_guard_window(running, valid, consec, total):
     """Display-cadence fetch of the guarded window: ONE host transfer for
     the mean-over-valid-steps loss plus both skip counters."""
-    r, v, c, t = jax.device_get((running, valid, consec, total))  # graftlint: disable=GL001(display-cadence fetch — the one deliberate sync point of the guarded window)
-    mean = float(r) / int(v) if int(v) else float("nan")  # graftlint: disable=GL001(host numpy values already fetched above, not device values)
-    return mean, int(c), int(t)  # graftlint: disable=GL001(host numpy values already fetched above, not device values)
+    r, v, c, t = jax.device_get((running, valid, consec, total))
+    mean = float(r) / int(v) if int(v) else float("nan")
+    return mean, int(c), int(t)
 
 
 @dataclass
@@ -121,6 +136,7 @@ class TrainResult:
     skipped_steps: int = 0      # finite-guard: updates skipped on
                                 # non-finite gradients (0 when disabled)
     rollbacks: int = 0          # circuit-breaker checkpoint restores
+    stage: int = 0              # curriculum stage at exit (flat runs: 0)
 
 
 def _finalize_goodput_ledger(rec, rec_path, run_id, process_index,
@@ -278,6 +294,9 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                           "windowed goodput at the last display: elapsed "
                           "minus data-wait, times the applied-update "
                           "fraction, over elapsed")
+    g_stage = reg.gauge("milnce_train_stage",
+                        "live curriculum stage index (0-based; flat runs "
+                        "stay 0)")
     # the data-wait accumulator device_prefetch feeds (create-or-get:
     # same child) — window deltas drive the live goodput gauge
     m_data_wait = reg.counter(
@@ -292,10 +311,15 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
     n_chips = len(jax.devices())
     dev0 = jax.devices()[0]
     peak = roofline_peak(str(getattr(dev0, "device_kind", dev0.platform)))
-    step_flops = None
-    if (peak and cfg.loss.name == "milnce" and cfg.train.grad_accum == 1):
-        step_flops = roofline_step_flops(
-            cfg.train.batch_size, cfg.data.num_frames, cfg.data.video_size,
+
+    def _stage_step_flops(st) -> Optional[float]:
+        # per-stage: the curriculum changes batch/frames/resolution, and
+        # a stale FLOPs count would make the live MFU gauge fiction
+        if not (peak and cfg.loss.name == "milnce"
+                and cfg.train.grad_accum == 1):
+            return None
+        return roofline_step_flops(
+            st.batch_size, st.num_frames, st.resolution,
             cfg.data.num_candidates, cfg.data.max_words,
             space_to_depth=cfg.model.space_to_depth,
             inception_blocks=cfg.model.inception_blocks,
@@ -335,20 +359,56 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
         except ValueError:       # non-main thread (tests)
             prev_usr1 = None
 
-    source = build_source(cfg, log_fn=logger.log)
-    loader = ShardedLoader(source, cfg.train.batch_size, seed=cfg.train.seed,
-                           num_threads=cfg.data.num_reader_threads,
-                           lookahead_batches=cfg.data.decode_lookahead,
-                           sample_timeout=cfg.data.sample_timeout,
-                           timeout_retries=cfg.data.sample_timeout_retries,
-                           log_fn=logger.log)
-    steps_per_epoch = loader.steps_per_epoch()
-    assert steps_per_epoch > 0, "dataset smaller than one global batch"
+    # ----- curriculum plan (train/curriculum.py) -----
+    # Flat runs are a single open-ended stage through the SAME plan
+    # machinery, so resume offsets / epoch progress / schedule totals
+    # have exactly one derivation (pinned equal to the historical
+    # modulo helpers by tests/test_curriculum.py).
+    stages = curriculum.parse_curriculum(
+        cfg.train.curriculum, default_batch_size=cfg.train.batch_size)
+    curriculum_on = bool(stages)
+    if not curriculum_on:
+        stages = curriculum.flat_stages(cfg.data, cfg.train.batch_size)
+    stage_cfgs = [curriculum.stage_config(cfg, st) for st in stages]
+    source0 = build_source(stage_cfgs[0], log_fn=logger.log)
+    plan = curriculum.plan_curriculum(stages, len(source0),
+                                      cfg.optim.epochs)
+    if curriculum_on:
+        rec.event("curriculum.plan", total_steps=plan.total_steps,
+                  stages=[{"num_frames": s.num_frames,
+                           "resolution": s.resolution,
+                           "batch_size": s.batch_size}
+                          for s in plan.stages])
+        logger.log("curriculum: "
+                   + " -> ".join(s.label() for s in plan.stages)
+                   + f" ({plan.total_steps} steps planned)")
+
+    def _stage_pipeline(idx: int):
+        """(source, loader, zero_start, step_flops) for one stage —
+        rebuilt at every boundary (the decode shapes and the hoisted
+        start fallback are per-stage; the model/optimizer are not)."""
+        st = plan.stages[idx]
+        src = (source0 if idx == 0
+               else build_source(stage_cfgs[idx], log_fn=logger.log))
+        ldr = ShardedLoader(src, st.batch_size, seed=cfg.train.seed,
+                            num_threads=cfg.data.num_reader_threads,
+                            lookahead_batches=cfg.data.decode_lookahead,
+                            sample_timeout=cfg.data.sample_timeout,
+                            timeout_retries=cfg.data.sample_timeout_retries,
+                            log_fn=logger.log)
+        zstart = shard_placer(mesh, batch_axes)(
+            np.zeros((st.batch_size // jax.process_count(), ),
+                     np.float32))
+        return src, ldr, zstart, _stage_step_flops(st)
 
     model = build_model(cfg.model, bn_axis_name=batch_axes)
     rng = jax.random.PRNGKey(cfg.train.seed)
-    sample_video = np.zeros((2, cfg.data.num_frames, cfg.data.video_size,
-                             cfg.data.video_size, 3), np.float32)
+    # init at stage-0 shapes: the TrainState tree is shape-invariant
+    # across stages (conv/BN params don't depend on frames/resolution),
+    # so transitions and checkpoints ride place_state untouched
+    st0 = plan.stages[0]
+    sample_video = np.zeros((2, st0.num_frames, st0.resolution,
+                             st0.resolution, 3), np.float32)
     sample_text = np.zeros((2 * cfg.data.num_candidates, cfg.data.max_words),
                            np.int32)
     variables = model.init(rng, sample_video, sample_text)
@@ -359,7 +419,11 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
         variables = load_torch_checkpoint_as_flax(cfg.train.pretrain_ckpt)
         logger.log(f"loaded pretrained weights from {cfg.train.pretrain_ckpt}")
 
-    schedule = build_schedule(cfg.optim, steps_per_epoch)
+    # Schedule over the PLAN's total (satellite: per-stage batch sizes
+    # make steps_per_epoch * epochs wrong for warmup/cosine totals) — a
+    # pure function of the global step, so opt-state structure and
+    # checkpoints are identical to a flat run's.
+    schedule = build_schedule_total(cfg.optim, plan.total_steps)
     optimizer = build_optimizer(cfg.optim, schedule)
     state = create_train_state(variables, optimizer)
 
@@ -400,19 +464,35 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
     manager = CheckpointManager(ckpt_dir, keep=cfg.train.checkpoint_keep,
                                 save_retries=cfg.train.checkpoint_save_retries)
     start_epoch = 0
-    resume_skip = 0
+    resume_step = 0
     if cfg.train.resume:
+        # Resume-compatibility guard BEFORE any Orbax I/O: a curriculum
+        # checkpoint resumed with train.curriculum removed would
+        # otherwise silently continue at the flat config's full shape
+        # (the state tree is shape-invariant, so nothing else fails).
+        curriculum.check_resume_compatible(
+            curriculum.read_stage_stamp(ckpt_dir),
+            curriculum_spec=cfg.train.curriculum,
+            flat_frames=cfg.data.num_frames,
+            flat_resolution=cfg.data.video_size,
+            flat_batch=cfg.train.batch_size)
         with rec.span("ckpt.restore", label="latest"):
             start_epoch, state = manager.restore_latest(state)
-        # Mid-epoch checkpoints (preemption / max_steps) are labeled with
-        # the CURRENT epoch; the restored step counter places us inside it,
-        # and the loader skips the consumed batches at the index level so
-        # no sample is trained twice (an end-of-epoch save lands on a
-        # steps_per_epoch boundary -> skip 0).  Only valid while
-        # steps_per_epoch matches the run being resumed.
-        resume_skip = resume_batch_offset(int(state.step), steps_per_epoch)
-        logger.log(f"resumed from epoch {start_epoch}"
-                   + (f" at batch {resume_skip}" if resume_skip else ""))
+        # Mid-epoch checkpoints (preemption / max_steps) are labeled
+        # with the CURRENT epoch; the restored step counter places us
+        # inside it via the plan's locate() — the containing stage
+        # segment plus its batch offset — so the loader skips the
+        # consumed batches at the index level and no sample is trained
+        # twice (an end-of-epoch save lands on a boundary -> offset 0).
+        resume_step = int(state.step)
+        resume_seg, resume_off = plan.locate(resume_step)
+        logger.log(
+            f"resumed from epoch {start_epoch}"
+            + (f" at batch {resume_seg.skip_batches + resume_off}"
+               if resume_step else "")
+            + (f" (curriculum stage {resume_seg.stage}, "
+               f"{plan.stages[resume_seg.stage].label()})"
+               if curriculum_on else ""))
 
     # Explicitly place the state (freshly initialized OR restored — both
     # land committed to one device) over the mesh NOW: leaving it
@@ -448,6 +528,24 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
             finite_guard=guard_on, state_specs=state_specs,
             model_axis=model_axis,
             overlap_grad_reduce=cfg.parallel.overlap_grad_reduce)
+
+    # Curriculum mem_plan pre-flight (train/curriculum.py, reusing the
+    # PR 8 autotune planner): every stage's step is statically planned
+    # against the per-chip HBM budget HERE — an over-budget stage is
+    # refused with its top-3 contributors named before anything traces
+    # or compiles, never an OOM at a mid-run boundary.
+    if curriculum_on:
+        budget = curriculum.hbm_budget_bytes()
+        if budget:
+            for note in curriculum.preflight_stages(
+                    step_fn, state, plan.stages,
+                    num_candidates=cfg.data.num_candidates,
+                    max_words=cfg.data.max_words, budget_bytes=budget):
+                logger.log(f"curriculum pre-flight: {note}")
+        else:
+            logger.log("curriculum pre-flight skipped: no per-chip HBM "
+                       "budget known (set MILNCE_HBM_GIB to arm the "
+                       "refusal gate)")
 
     # Preemption-safe shutdown: TPU-VM maintenance events deliver SIGTERM;
     # save a checkpoint and exit cleanly instead of losing the epoch (the
@@ -500,7 +598,6 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
     last_rollback = None        # (total_steps, total_skips) at the last
                                 # breaker trip — bounds the rollback loop
     window = 0
-    timer = StepTimer(clips_per_step=cfg.train.batch_size)
     # Wall clock feeds the human-facing elapsed display only; bench numbers
     # come from utils/timing.py's differenced protocol.
     # graftlint: disable=GL005(elapsed-display only; the windowed loss fetch at the same cadence is the device sync)
@@ -522,28 +619,30 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
     # LR display comes from the numpy twin of the device schedule:
     # float(schedule(step)) of the jnp form was a per-display device
     # round-trip (the original graftlint finding this PR fixes).
-    host_schedule = build_host_schedule(cfg.optim, steps_per_epoch)
+    host_schedule = build_host_schedule_total(cfg.optim, plan.total_steps)
 
-    # Hoisted fallback for sources without per-clip start times: building
-    # np.zeros INSIDE the loop fed the jitted step an implicit H2D
-    # transfer every step.  Placed once, explicitly, mesh-sharded via the
-    # same placement helper the prefetcher uses.
-    zero_start = shard_placer(mesh, batch_axes)(
-        np.zeros((cfg.train.batch_size // jax.process_count(),),
-                 np.float32))
+    # Initial stage pipeline (a resume may land past stage 0 — the plan
+    # says where).  The hoisted zero_start fallback: building np.zeros
+    # INSIDE the loop fed the jitted step an implicit H2D transfer every
+    # step; placed once per STAGE, explicitly, mesh-sharded via the same
+    # placement helper the prefetcher uses.
+    stage_idx = plan.stage_at(resume_step)
+    source, loader, zero_start, step_flops = _stage_pipeline(stage_idx)
+    timer = StepTimer(clips_per_step=plan.stages[stage_idx].batch_size)
+    g_stage.set(stage_idx)
 
     def fetch(dev_val) -> float:
         # the ONE audited transfer of the display path (off-cadence by
         # design; see the n_display branch)
-        return (float(jax.device_get(dev_val))  # graftlint: disable=GL001(display/exit-cadence fetch of the windowed loss — the deliberate sync point, not a per-step one)
+        return (float(jax.device_get(dev_val))
                 if dev_val is not None else float("nan"))
 
     def exit_metrics():
         # one transfer covers both the final loss and the skip counter
         if skips_total_dev is None:
             return fetch(last_loss_dev), 0
-        last, k = jax.device_get((last_loss_dev, skips_total_dev))  # graftlint: disable=GL001(exit-cadence fetch — one transfer for final loss + skip count)
-        return float(last), int(k)  # graftlint: disable=GL001(host numpy values already fetched above, not device values)
+        last, k = jax.device_get((last_loss_dev, skips_total_dev))
+        return float(last), int(k)
 
     def check_finite(mean_loss: float, step_label: int) -> None:
         """Divergence guard, evaluated only at display fetches (no extra
@@ -583,10 +682,55 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                     and epoch % eval_every == 0):
                 with jax.transfer_guard("allow"):   # epoch-cadence eval
                     _in_training_eval(cfg, model, state, mesh, logger)
-            skip = resume_skip if epoch == start_epoch else 0
-            for batch in device_prefetch(loader.epoch(epoch, skip_batches=skip),
-                                         mesh, batch_axes,
-                                         depth=cfg.data.prefetch_depth):
+            for seg in plan.segments_for_epoch(epoch):
+              # Resume offsets come from the plan's locate() semantics:
+              # segments fully consumed by the restored step are skipped
+              # whole; the containing one starts at its batch offset.
+              seg_done = 0
+              if resume_step:
+                  if resume_step >= seg.end_step:
+                      continue
+                  seg_done = max(0, resume_step - seg.start_step)
+                  resume_step = 0       # applies once
+              if seg.stage != stage_idx:
+                  # Curriculum boundary: the previous stage's prefetcher
+                  # is already drained (closed below); rebuild the
+                  # pipeline at the new shapes.  The stage.switch span
+                  # feeds the goodput ledger's stage_switch bucket; the
+                  # NEXT step dispatch blocks on the new stage's
+                  # trace+compile (one fresh jit entry per stage) and
+                  # the ledger attributes that step there too.
+                  st = plan.stages[seg.stage]
+                  with jax.transfer_guard("allow"):   # boundary cadence
+                    with rec.span("stage.switch", stage=seg.stage,
+                                  prev_stage=stage_idx,
+                                  step=opt_step0 + total_steps,
+                                  num_frames=st.num_frames,
+                                  resolution=st.resolution,
+                                  batch_size=st.batch_size):
+                        (source, loader, zero_start,
+                         step_flops) = _stage_pipeline(seg.stage)
+                  stage_idx = seg.stage
+                  g_stage.set(stage_idx)
+                  logger.log(f"curriculum: entering stage {stage_idx} "
+                             f"({st.label()}) at step "
+                             f"{opt_step0 + total_steps}")
+                  # fresh stage, fresh display window — the windowed
+                  # loss/throughput must not mix shapes across the
+                  # boundary (the loss-continuity acceptance compares
+                  # post-switch windows against a flat run at the new
+                  # shape)
+                  running_dev = None
+                  valid_dev = None
+                  window = 0
+                  timer = StepTimer(clips_per_step=st.batch_size)
+                  window_wait0 = m_data_wait.value
+                  tick = time.time()
+              prefetch = device_prefetch(
+                  loader.epoch(epoch,
+                               skip_batches=seg.skip_batches + seg_done),
+                  mesh, batch_axes, depth=cfg.data.prefetch_depth)
+              for batch in prefetch:
                 video, text = flatten_text(batch)
                 start = batch.get("start", zero_start)
                 # span times HOST dispatch of the async step (device
@@ -609,6 +753,7 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                 # stay cluster-uniform).
                 loss = loss.addressable_data(0)
                 total_steps += 1
+                seg_done += 1
                 window += 1
                 timer.tick()
                 # async device-side accumulation — no host sync here (the
@@ -637,7 +782,12 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                   # so they stay correct across resumes with no sync.
                   opt_step = opt_step0 + total_steps
                   lr = host_schedule(opt_step)
-                  progress = (opt_step % steps_per_epoch) / steps_per_epoch
+                  # epoch progress from the plan (per-stage batch sizes
+                  # make a run-constant steps_per_epoch meaningless);
+                  # the modulo keeps the flat-run display byte-identical
+                  ep_len = max(1, plan.epoch_steps(epoch))
+                  progress = ((opt_step - plan.epoch_start_step(epoch))
+                              % ep_len) / ep_len
                   with jax.transfer_guard("allow"):  # display-cadence fetch
                     consec = 0
                     k_total = 0
@@ -653,6 +803,8 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                                 skips_total_dev)
                         else:
                             mean_loss = fetch(running_dev) / window
+                    if curriculum_on:
+                        extra += f", Stage: {stage_idx}"
                     if guard_on:
                         extra += f", Skipped steps: {k_total}"
                     fails = getattr(source, "decode_failures", 0)
@@ -665,7 +817,7 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                     # window they describe
                     sps = timer.steps_per_sec
                     elapsed = timer.elapsed_s
-                    clips_per_sec = sps * cfg.train.batch_size
+                    clips_per_sec = sps * plan.stages[stage_idx].batch_size
                     if step_flops is not None and sps > 0:
                         last_mfu = roofline_mfu(step_flops, sps, peak,
                                                 n_chips)
@@ -704,9 +856,10 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                     if guard_on:
                         g_skipped.set(k_total)
                     rec.event("display", step=opt_step, epoch=epoch + 1,
-                              loss=float(mean_loss), lr=float(lr),  # graftlint: disable=GL001(json-coercion of the host numpy values the display fetch above already materialized, not device values)
+                              loss=float(mean_loss), lr=float(lr),
                               clips_per_sec=clips_per_sec,
                               goodput_fraction=round(goodput_frac, 5),
+                              stage=stage_idx,
                               skipped_total=k_total,
                               **({"mfu": round(last_mfu, 5)}
                                  if last_mfu is not None else {}))
@@ -766,7 +919,7 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                                     "instead of rolling back in a loop")
                         last_rollback = (total_steps, k_total)
                         manager.wait()
-                        with rec.span("ckpt.restore", label=int(latest)):  # graftlint: disable=GL001(host epoch label from Orbax's step listing, not a device value)
+                        with rec.span("ckpt.restore", label=int(latest)):
                             restored = manager.restore(latest, state)
                         state = restored.replace(
                             step=jnp.asarray(opt_step, jnp.int32))
@@ -777,11 +930,14 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                         # applied updates since the restored boundary
                         # save are now discarded — the skipped streak
                         # is already badput, so it doesn't count twice
+                        # checkpoint labeled L holds state at epoch L's
+                        # start — the plan maps that to a global step
+                        # even when stages change the per-epoch count
                         lost = max(0, (opt_step
-                                       - int(latest) * steps_per_epoch  # graftlint: disable=GL001(host epoch label from Orbax's step listing, not a device value)
+                                       - plan.epoch_start_step(int(latest))
                                        - consec))
                         rec.event("rollback", step=opt_step,
-                                  restored_epoch=int(latest),  # graftlint: disable=GL001(host epoch label from Orbax's step listing, not a device value)
+                                  restored_epoch=int(latest),
                                   consecutive_skips=consec,
                                   lost_updates=lost)
                         consec_dev = None       # fresh weights: reset streak
@@ -814,23 +970,48 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                         logger.log("SIGTERM — checkpointing and exiting"
                                    + (" (cluster-coordinated)" if multi
                                       else ""))
-                    # label/force semantics: stop_save_label (module top);
-                    # epoch-boundary edge cases pinned in
+                    # label/force semantics: stop_save_label (module
+                    # top); the planned twin handles per-stage epoch
+                    # lengths.  Edge cases pinned in
                     # tests/test_resilience.py + test_train.py
-                    label, force = stop_save_label(
-                        epoch, opt_step0 + total_steps, steps_per_epoch)
-                    with rec.span("ckpt.save", label=label, forced=force):
+                    label, force = stop_save_label_planned(
+                        epoch, opt_step0 + total_steps, plan)
+                    with rec.span("ckpt.save", label=label, forced=force,
+                                  stage=stage_idx):
                         manager.save(label, state, force=force)
                         manager.wait()
+                    if process_index == 0:
+                        curriculum.write_stage_stamp(
+                            ckpt_dir, spec=cfg.train.curriculum,
+                            stage_index=stage_idx,
+                            stage=plan.stages[stage_idx],
+                            step=opt_step0 + total_steps)
                     last, skips = exit_metrics()
                     return TrainResult(state, total_steps, last,
-                                       skips, rollbacks)
+                                       skips, rollbacks, stage_idx)
+                if seg_done >= seg.n_steps:
+                    break       # segment complete (stage boundary or
+                                # epoch tail) — drain + re-arm below
+              # Deterministic drain at the segment edge: close the
+              # prefetch generator so its in-flight decode futures and
+              # device puts retire via the loader's finally blocks NOW,
+              # not at GC — the old stage's readers must not race the
+              # new stage's (and the stage.switch span must not start
+              # while they run).
+              prefetch.close()
             with jax.transfer_guard("allow"):       # epoch-boundary save
                 # the span times the async SUBMIT (Orbax writes in the
                 # background); the stop-save span above times a full
                 # submit+wait
-                with rec.span("ckpt.save", label=epoch + 1, forced=False):
+                with rec.span("ckpt.save", label=epoch + 1, forced=False,
+                              stage=stage_idx):
                     manager.save(epoch + 1, state)
+                if process_index == 0:
+                    curriculum.write_stage_stamp(
+                        ckpt_dir, spec=cfg.train.curriculum,
+                        stage_index=stage_idx,
+                        stage=plan.stages[stage_idx],
+                        step=opt_step0 + total_steps)
     finally:
         manager.wait()
         if cfg.train.faults:
@@ -855,4 +1036,5 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
         obs_runctx.set_run_context(*prev_runctx)
         logger.close()
     last, skips = exit_metrics()
-    return TrainResult(state, total_steps, last, skips, rollbacks)
+    return TrainResult(state, total_steps, last, skips, rollbacks,
+                       stage_idx)

@@ -39,19 +39,35 @@ def cosine_with_warmup(base_lr: float, num_warmup_steps: int,
     return schedule
 
 
-def build_schedule(cfg: OptimConfig, steps_per_epoch: int, xp=jnp):
-    total = steps_per_epoch * cfg.epochs
-    return cosine_with_warmup(cfg.lr, cfg.warmup_steps, total,
+def build_schedule_total(cfg: OptimConfig, total_steps: int, xp=jnp):
+    """Schedule over an explicit run-total step count.  The curriculum
+    path (train/curriculum.py) computes the total from its step-level
+    plan — per-stage batch sizes make ``steps_per_epoch * epochs`` wrong
+    there, which would silently stretch/compress warmup and the cosine
+    tail.  The schedule stays a pure function of the GLOBAL step, so the
+    optimizer state keeps one structure across stages and checkpoints
+    stay compatible."""
+    return cosine_with_warmup(cfg.lr, cfg.warmup_steps, total_steps,
                               cfg.num_cycles, xp=xp)
 
 
-def build_host_schedule(cfg: OptimConfig, steps_per_epoch: int):
-    """``step -> float`` twin of :func:`build_schedule` computed entirely
-    with numpy — no device values touched, so the hot loop's LR display
-    never blocks (and never trips the steady-state transfer guard)."""
-    sched = build_schedule(cfg, steps_per_epoch, xp=np)
+def build_schedule(cfg: OptimConfig, steps_per_epoch: int, xp=jnp):
+    return build_schedule_total(cfg, steps_per_epoch * cfg.epochs, xp=xp)
+
+
+def build_host_schedule_total(cfg: OptimConfig, total_steps: int):
+    """``step -> float`` twin of :func:`build_schedule_total` computed
+    entirely with numpy — no device values touched, so the hot loop's LR
+    display never blocks (and never trips the steady-state transfer
+    guard)."""
+    sched = build_schedule_total(cfg, total_steps, xp=np)
 
     def host_schedule(step: int) -> float:
         return float(sched(step))
 
     return host_schedule
+
+
+def build_host_schedule(cfg: OptimConfig, steps_per_epoch: int):
+    """Flat-run convenience over :func:`build_host_schedule_total`."""
+    return build_host_schedule_total(cfg, steps_per_epoch * cfg.epochs)

@@ -63,8 +63,41 @@ def _force_devices(n: int) -> None:
 HEADER = ("<!-- (auto-written by scripts/mem_plan.py — do not hand-edit; "
           "regenerate with `python scripts/mem_plan.py`) -->\n")
 
+# The committed curriculum operating-point ladder (PERF.md "Curriculum
+# training"): the staged recipe recommended for the paper's full run,
+# pre-flighted here so the 32f@224 final stage's fit is triaged before
+# any chip time.  Regen recomputes every row, so the table tracks the
+# current model + planner.  The ga=1 final-stage row is kept
+# deliberately: it documents WHY the recipe carries grad_accum=8.
+#   (label, frames, size, batch, grad_accum)
+CURRICULUM_LADDER = (
+    ("stage 0", 4, 64, 512, 1),
+    ("stage 1", 8, 112, 256, 1),
+    ("stage 2 (ga=1, naive)", 32, 224, 256, 1),
+    ("stage 2 (ga=8)", 32, 224, 256, 8),
+)
+LADDER_MESH = {"data": 4, "model": 2}   # v5e-8 slice
+LADDER_HBM_GIB = 16.0
 
-def _render_memplan(plans: dict, results) -> str:
+
+def _plan_ladder(memplan) -> list:
+    """(label, shape, batch, ga, peak_bytes, fits, top_label) per ladder
+    row — the curriculum section of MEMPLAN.md."""
+    rows = []
+    for label, frames, size, batch, ga in CURRICULUM_LADDER:
+        p = memplan.what_if_step(
+            batch=batch, frames=frames, size=size, grad_accum=ga,
+            mesh_axes=dict(LADDER_MESH))
+        fits, _ = memplan.budget_verdict(p, LADDER_HBM_GIB)
+        top = (f"{p.contributors[0][0]} "
+               f"({p.contributors[0][1] / 2**20:.0f} MiB)"
+               if p.contributors else "-")
+        rows.append((label, f"{frames}f@{size}", batch, ga,
+                     p.peak_bytes, fits, top))
+    return rows
+
+
+def _render_memplan(plans: dict, results, ladder=None) -> str:
     lines = [HEADER, "# MEMPLAN — static per-chip HBM plan", ""]
     lines.append(
         "Per-entry peak device bytes from jaxpr live-range analysis "
@@ -115,6 +148,31 @@ def _render_memplan(plans: dict, results) -> str:
                  "traces and refuses configs that don't fit — see "
                  "PERF.md \"Memory planning\".")
     lines.append("")
+    if ladder:
+        mesh = "x".join(str(n) for n in LADDER_MESH.values())
+        axes = ",".join(LADDER_MESH)
+        lines.append("## Curriculum ladder (operating points)")
+        lines.append("")
+        lines.append(
+            f"The staged recipe from PERF.md \"Curriculum training\", "
+            f"pre-flighted on {mesh} ({axes}) against the v5e "
+            f"{LADDER_HBM_GIB:.0f} GiB/chip budget — the same per-stage "
+            "prediction `run_training` performs at startup before any "
+            "stage is traced.  One invocation reproduces it: "
+            "`python scripts/mem_plan.py --what-if --curriculum "
+            "'<spec>' --mesh data=4,model=2 --hbm-gib 16`.  The naive "
+            "ga=1 final stage is listed to show the triage: 32f@224 at "
+            "batch 256 only fits with gradient accumulation.")
+        lines.append("")
+        lines.append("| stage | shape | batch | grad-accum | peak/chip "
+                     "| fits 16 GiB | top contributor |")
+        lines.append("|---|---|---|---|---|---|---|")
+        for label, shape, batch, ga, peak, fits, top in ladder:
+            verdict = "yes" if fits else "**NO — refused at pre-flight**"
+            lines.append(f"| {label} | {shape} | {batch} | {ga} "
+                         f"| {peak / 2**30:.3f} GiB | {verdict} "
+                         f"| {top} |")
+        lines.append("")
     return "\n".join(lines)
 
 
@@ -144,6 +202,13 @@ def main(argv=None) -> int:
     ap.add_argument("--milnce-chunk", type=int, default=0,
                     help="chunked-impl streamed block size (0 = the "
                          "milnce_default_chunk rule)")
+    ap.add_argument("--curriculum", default="",
+                    help="with --what-if: a train.curriculum spec (or "
+                         "JSON artifact path) — predict EVERY stage as "
+                         "its own operating point in one invocation and "
+                         "exit 1 if any stage exceeds --hbm-gib; "
+                         "--grad-accum/--words/--k/--dtype apply to all "
+                         "stages")
     ap.add_argument("--mesh", default="",
                     help="'data=4,model=2' (what-if; '' = 8-way data)")
     ap.add_argument("--hbm-gib", type=float, default=16.0,
@@ -166,6 +231,37 @@ def main(argv=None) -> int:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
     from milnce_tpu.analysis import memplan
+
+    if args.what_if and args.curriculum:
+        # stdlib parser (train/curriculum.py imports no jax at module
+        # scope beyond what this process already initialised)
+        from milnce_tpu.train.curriculum import parse_curriculum
+
+        stages = parse_curriculum(args.curriculum,
+                                  default_batch_size=args.batch)
+        rows, refused = [], []
+        for i, st in enumerate(stages):
+            plan = memplan.what_if_step(
+                batch=st.batch_size, frames=st.num_frames,
+                size=st.resolution, words=args.words, k=args.k,
+                dtype=args.dtype, grad_accum=args.grad_accum,
+                mesh_axes=mesh_axes, preset=args.preset,
+                loss_impl=args.loss_impl,
+                milnce_chunk=args.milnce_chunk)
+            fits, msg = memplan.budget_verdict(plan, args.hbm_gib)
+            rows.append((i, st, plan, fits))
+            if not fits:
+                refused.append((i, st, msg))
+        print("| stage | shape | batch | peak/chip | fits "
+              f"{args.hbm_gib:g} GiB |")
+        print("|---|---|---|---|---|")
+        for i, st, plan, fits in rows:
+            print(f"| {i} | {st.num_frames}f@{st.resolution} "
+                  f"| {st.batch_size} | {plan.peak_bytes / 2**30:.3f} "
+                  f"GiB | {'yes' if fits else '**NO**'} |")
+        for i, st, msg in refused:
+            print(f"\nstage {i} ({st.label()}) REFUSED: {msg}")
+        return 1 if refused else 0
 
     if args.what_if:
         plan = memplan.what_if_step(
@@ -200,8 +296,12 @@ def main(argv=None) -> int:
             print(f'    "{name}": (\n        {tops}),')
         print("}")
     if args.report:
+        # recompute the committed curriculum ladder alongside the entry
+        # plans (~9s/row of pure CPU tracing) so the operating-point
+        # table can never go stale against the model
+        ladder = _plan_ladder(memplan)
         with open(args.report, "w") as fh:
-            fh.write(_render_memplan(plans, results))
+            fh.write(_render_memplan(plans, results, ladder=ladder))
         print(f"report: {args.report}")
     print(f"mem_plan: {len(plans)} entries planned, {n_bad} finding(s)")
     return 1 if (args.check and n_bad) else 0

@@ -359,6 +359,31 @@ def test_live_index_gates_exist_and_stay_tier1():
             f"{fname}::{slow}")
 
 
+# curriculum gates (ISSUE 16): the staged-schedule grammar + plan
+# simulator (including the resume_batch_offset / stop_save_label
+# equivalence the flat path rides on), the checkpoint-compatible stage
+# transitions, the pre-flight refusal and the goodput stage_switch
+# attribution are the regression fence for curriculum training.  Same
+# rule as every other subsystem gate: tier-1, never @slow, never
+# vanished.
+_CURRICULUM_GATES = ("test_curriculum.py",)
+
+
+def test_curriculum_gates_exist_and_stay_tier1():
+    for fname in _CURRICULUM_GATES:
+        path = os.path.join(_TESTS, fname)
+        assert os.path.exists(path), f"curriculum gate {fname} is missing"
+        src = open(path).read()
+        tests = list(_iter_tests(ast.parse(src)))
+        assert tests, f"{fname} defines no tests"
+        slow = [node.name for node, class_slow in tests
+                if _is_slow_marked(node, class_slow)]
+        assert not slow, (
+            "curriculum tests must be tier-1/CPU-safe, never @slow "
+            "(they are the staged-training regression fence): "
+            f"{fname}::{slow}")
+
+
 def test_fast_child_exemptions_stay_real():
     """Every _FAST_CHILD_EXEMPT entry must name a test that still
     exists — a stale exemption is a hole the audit thinks it covers."""
