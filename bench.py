@@ -433,6 +433,23 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
         _note(f"bench: memplan prediction failed ({type(exc).__name__}: "
               f"{exc}) — row ships without predicted_peak_bytes_per_chip")
 
+    # precision fingerprint of the timed program (graftlint Pass 5,
+    # analysis/numerics.py): sha of the dtype census + cast inventory
+    # rides in the record so obs_report --check can FLAG cross-precision
+    # compares — a bf16 row beating an f32 baseline is a dtype change,
+    # not a speedup.  Best-effort for the same reason as the plan.
+    dtype_census_hash = None
+    try:
+        from milnce_tpu.analysis.numerics import audit_fn
+
+        dtype_census_hash = audit_fn(
+            step_fn, (state, video_d, text_d, start_d),
+            argnames=("state", "video", "text", "start"),
+            entry="bench").census_hash()
+    except Exception as exc:
+        _note(f"bench: numerics audit failed ({type(exc).__name__}: "
+              f"{exc}) — row ships without dtype_census_hash")
+
     # warmup / compile (NOT `loss` — that name is the loss-selector arg
     # and ends up verbatim in the result record)
     state, warmup_loss = step_fn(state, video_d, text_d, start_d)
@@ -533,6 +550,7 @@ def _bench_config(dtype: str, batch: int, frames: int, size: int,
         "flops_source": flops_source if flops else None,
         "flops_per_sec": (flops * inner / dt) if flops else None,
         "predicted_peak_bytes_per_chip": predicted_peak,
+        "dtype_census_hash": dtype_census_hash,
     }
     if peak and flops:
         # the SHARED MFU definition (utils/roofline.py) — identical to
@@ -717,8 +735,10 @@ def _make_record(best, frames, size, on_tpu, kind):
     # can only compare 1-D and 2-D runs if the record says which layout
     # (and which map) produced the number.  predicted_peak_bytes_per_chip
     # (ISSUE 8) makes memory drift gateable the same way.
+    # dtype_census_hash (Pass 5) rides along so a cross-precision
+    # compare is flagged, not silently scored as a speedup/regression
     for key in ("mesh", "sharding_map_hash", "params_sharded",
-                "predicted_peak_bytes_per_chip"):
+                "predicted_peak_bytes_per_chip", "dtype_census_hash"):
         if best.get(key) is not None:
             out[key] = best[key]
     if not on_tpu:

@@ -69,13 +69,17 @@ _ARRAY_ROOTS = {"np", "numpy", "jnp"}
 _FLOAT_DEFAULT_CTORS = {"zeros", "ones", "empty", "linspace", "eye"}
 _VALUE_CTORS = {"array", "asarray", "full"}
 
-# Entry-level (jaxpr) rules — the Pass 4 planner's GL013-GL015 attach to
-# registered trace entries, never to source lines, so an inline
-# suppression can never match anything: writing one is itself a GL000
-# (the stale-suppression audit, extended to the rules that cannot fire
-# here).  The sanctioned "suppression" is a conscious re-pin of the
-# expectation tables in analysis/memplan.py, same commit.
-_ENTRY_LEVEL_RULES = frozenset({"GL013", "GL014", "GL015"})
+# Entry-level (jaxpr) rules — the Pass 4 planner's GL013-GL015 and the
+# Pass 5 numerics gates GL016/GL018 attach to registered trace entries,
+# never to source lines, so an inline suppression can never match
+# anything: writing one is itself a GL000 (the stale-suppression audit,
+# extended to the rules that cannot fire here).  The sanctioned
+# "suppression" is a conscious re-pin of the expectation tables in
+# analysis/memplan.py or analysis/numerics.py, same commit.  GL017 is
+# NOT here: its AST half fires on source lines in losses/ and takes a
+# reasoned inline suppression like any Pass 1 rule.
+_ENTRY_LEVEL_RULES = frozenset({"GL013", "GL014", "GL015",
+                                "GL016", "GL018"})
 
 _SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=(?P<body>.+)$")
 _ITEM_RE = re.compile(r"\s*(?P<rule>[A-Za-z0-9_-]+)\s*(?:\((?P<reason>.*)\))?\s*$")
@@ -155,8 +159,9 @@ def parse_suppressions(src: str, path: str) -> tuple[list[Suppression],
                 bad.append(Finding(
                     path, lineno, RULES["GL000"],
                     f"suppression of {rule.id} ({rule.name}): entry-level "
-                    "planner rules never fire on source lines — re-pin "
-                    "the expectation in analysis/memplan.py instead"))
+                    "planner/numerics rules never fire on source lines — "
+                    "re-pin the expectation table in analysis/memplan.py "
+                    "or analysis/numerics.py instead"))
             elif not reason:
                 bad.append(Finding(path, lineno, RULES["GL000"],
                                    f"suppression of {rule.id} carries no reason "
@@ -658,6 +663,107 @@ class _ModuleLint:
                            "declares — GSPMD silently replicates a phantom "
                            "axis instead of erroring")
 
+    # ---- GL017: unstabilized exp domain (losses/ only) -------------------
+
+    # calls whose result is a legitimate exp guard (the max-subtraction
+    # trick and its bounded-domain relatives)
+    _GUARD_CALLS = {"max", "maximum", "amax", "min", "minimum", "clip",
+                    "logsumexp", "stop_gradient"}
+
+    def _guard_names(self) -> set:
+        """Names carrying a max/lse-derived bound, by fixed point: a
+        name assigned from a guard call, from an expression referencing
+        another guard name, or lexically guard-like (``row_lse``,
+        ``m_new``-style running maxima are the losses' house idiom) —
+        so ``rls = row_lse[:, None]; jnp.exp(x - rls)`` reads guarded."""
+        def lexical(name: str) -> bool:
+            return "max" in name or "lse" in name
+
+        assigns = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign):
+                names = [t.id for t in node.targets
+                         if isinstance(t, ast.Name)]
+                if names:
+                    assigns.append((names, node.value))
+        guards: set = set()
+        changed = True
+        while changed:
+            changed = False
+            for names, value in assigns:
+                if all(n in guards for n in names):
+                    continue
+                hit = False
+                for sub in ast.walk(value):
+                    if isinstance(sub, ast.Call):
+                        t, _r = _terminal_and_root(sub.func)
+                        if t in self._GUARD_CALLS:
+                            hit = True
+                            break
+                    elif isinstance(sub, ast.Name) and (
+                            sub.id in guards or lexical(sub.id)):
+                        hit = True
+                        break
+                if hit:
+                    for n in names:
+                        if n not in guards:
+                            guards.add(n)
+                            changed = True
+        return guards
+
+    def check_exp_stability(self) -> None:
+        """GL017, AST half — scoped to ``losses/`` modules (the jaxpr
+        half in analysis/numerics.py confirms guards survive tracing on
+        every registered entry): ``exp`` whose argument shows no
+        subtraction of a guard, and divisions whose denominator IS a
+        bare reduced sum (no eps / maximum floor)."""
+        parts = self.path.replace("\\", "/").split("/")
+        if "losses" not in parts:
+            return
+        guards = self._guard_names()
+
+        def guard_ref(node: ast.AST) -> bool:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name) and (
+                        sub.id in guards or "max" in sub.id
+                        or "lse" in sub.id):
+                    return True
+                if isinstance(sub, ast.Call):
+                    t, _r = _terminal_and_root(sub.func)
+                    if t in self._GUARD_CALLS:
+                        return True
+            return False
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                terminal, _root = _terminal_and_root(node.func)
+                if terminal == "exp" and node.args:
+                    arg = node.args[0]
+                    guarded = (isinstance(arg, ast.Name)
+                               and arg.id in guards)
+                    if not guarded:
+                        for sub in ast.walk(arg):
+                            if (isinstance(sub, ast.BinOp)
+                                    and isinstance(sub.op, ast.Sub)
+                                    and guard_ref(sub.right)):
+                                guarded = True
+                                break
+                    if not guarded:
+                        self._emit("GL017", node,
+                                   "exp without a max-subtraction guard "
+                                   "— overflows f32 at x>88 (subtract "
+                                   "the row max or reuse the "
+                                   "logsumexp/online-softmax bound)")
+            elif (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Div)
+                    and isinstance(node.right, ast.Call)):
+                t, _r = _terminal_and_root(node.right.func)
+                if t in ("sum", "reduce_sum"):
+                    self._emit("GL017", node,
+                               "division by a reduced sum without an "
+                               "eps/maximum floor — an all-masked row "
+                               "divides by zero")
+
     # ---- driver ----------------------------------------------------------
 
     def run(self) -> list[Finding]:
@@ -668,6 +774,7 @@ class _ModuleLint:
         self.check_timing()
         self.check_broad_except()
         self.check_sharding_axes()
+        self.check_exp_stability()
         return self.findings
 
 
@@ -696,7 +803,7 @@ def _parent_functions(tree: ast.Module) -> dict:
 # scope (a `graft_lint milnce_tpu/serving` narrowed run must not call a
 # cross-module cycle's audited suppression stale).
 _PASS1_RULES = frozenset({"GL001", "GL002", "GL003", "GL004", "GL005",
-                          "GL006", "GL007", "GL008", "GL009"})
+                          "GL006", "GL007", "GL008", "GL009", "GL017"})
 _PASS3_STALE_RULES = frozenset({"GL010", "GL012"})
 
 

@@ -1110,20 +1110,26 @@ def run_memplan_checks(entries=None, plans=None) -> list:
 # what-if prediction (operating points the CPU can only trace, not run)
 # --------------------------------------------------------------------------
 
-def what_if_step(*, batch: int, frames: int, size: int, words: int = 20,
-                 k: int = 5, dtype: str = "bfloat16", grad_accum: int = 1,
-                 mesh_axes=None, preset: str = "full",
-                 fsdp_min_size=None, loss_impl: str = "dense",
-                 milnce_chunk: int = 0) -> MemPlan:
-    """Predict the per-chip peak of the train step at a (possibly TPU-
-    scale) operating point from a CPU trace: the model is built at the
-    requested config, the state comes from ``jax.eval_shape`` (no bytes
-    allocated), and ``make_jaxpr`` over ShapeDtypeStructs gives the
-    exact program the operating point would compile — tracing is
-    abstract, so a batch-256 32f@224 plan costs seconds of host time
-    and zero device memory.  ``mesh_axes`` like ``{'data': 4,
-    'model': 2}`` needs ``prod(sizes)`` visible devices
-    (scripts/mem_plan.py forces the virtual-CPU count to match)."""
+def what_if_program(*, batch: int, frames: int, size: int, words: int = 20,
+                    k: int = 5, dtype: str = "bfloat16",
+                    grad_accum: int = 1, mesh_axes=None,
+                    preset: str = "full", fsdp_min_size=None,
+                    loss_impl: str = "dense",
+                    milnce_chunk: int = 0) -> tuple:
+    """Trace the train step at a (possibly TPU-scale) operating point
+    on the CPU: the model is built at the requested config, the state
+    comes from ``jax.eval_shape`` (no bytes allocated), and
+    ``make_jaxpr`` over ShapeDtypeStructs gives the exact program the
+    operating point would compile — tracing is abstract, so a
+    batch-256 32f@224 program costs seconds of host time and zero
+    device memory.  ``mesh_axes`` like ``{'data': 4, 'model': 2}``
+    needs ``prod(sizes)`` visible devices (scripts/mem_plan.py forces
+    the virtual-CPU count to match).
+
+    Returns ``(closed_jaxpr, labels, donated, entry_desc, mesh_desc)``
+    — the shared what-if substrate: Pass 4 (what_if_step) runs the
+    live-range walk over it, Pass 5 (numerics.what_if_audit) the
+    dtype-flow walk, over the SAME traced program."""
     import jax
     import jax.numpy as jnp
 
@@ -1204,11 +1210,24 @@ def what_if_step(*, batch: int, frames: int, size: int, words: int = 20,
     mesh_desc = "x".join(f"{n}" for n in mesh_axes.values()) + (
         f" ({','.join(mesh_axes)})")
     impl_tag = "" if loss_impl == "dense" else f", loss={loss_impl}"
-    return plan_fn(step, args, argnames=_STEP_ARGNAMES,
-                   donate_argnums=STATE_DONATION_ARGNUMS,
-                   entry=f"what_if(batch={batch}, {frames}f@{size}, "
-                         f"{dtype}, ga={grad_accum}{impl_tag})",
-                   mesh=mesh_desc)
+    entry_desc = (f"what_if(batch={batch}, {frames}f@{size}, "
+                  f"{dtype}, ga={grad_accum}{impl_tag})")
+    return (jax.make_jaxpr(step)(*args),
+            arg_leaf_labels(args, _STEP_ARGNAMES),
+            donated_leaf_flags(args, STATE_DONATION_ARGNUMS),
+            entry_desc, mesh_desc)
+
+
+def what_if_step(*, batch: int, frames: int, size: int, **kw) -> MemPlan:
+    """Predict the per-chip peak of the train step at an operating
+    point — the live-range walk over :func:`what_if_program`'s trace
+    (flags documented there; scripts/mem_plan.py is the CLI)."""
+    closed, labels, donated, entry_desc, mesh_desc = what_if_program(
+        batch=batch, frames=frames, size=size, **kw)
+    plan = analyze_jaxpr(closed, donated=donated, labels=labels)
+    plan.entry = entry_desc
+    plan.mesh = mesh_desc
+    return plan
 
 
 def budget_verdict(plan: MemPlan, hbm_gib: float) -> tuple:

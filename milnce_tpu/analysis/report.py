@@ -19,7 +19,8 @@ HEADER = ("<!-- (auto-written by scripts/graft_lint.py — do not hand-edit; "
 
 
 def render_report(findings: list[Finding], trace_results=None,
-                  paths=None, lock_graph=None, mem_results=None) -> str:
+                  paths=None, lock_graph=None, mem_results=None,
+                  numerics_results=None) -> str:
     active = [f for f in findings if not f.suppressed]
     suppressed = [f for f in findings if f.suppressed]
     lines = [HEADER, "# graftlint report", ""]
@@ -100,6 +101,26 @@ def render_report(findings: list[Finding], trace_results=None,
         lines.append("| entry | check | status |")
         lines.append("|---|---|---|")
         for r in mem_results:
+            status = "ok" if r.ok else f"**FAIL** — {r.detail}"
+            lines.append(f"| {r.entry} | {r.check} | {status} |")
+    lines.append("")
+
+    lines.append("## Pass 5 — numerics (GL016-GL018)")
+    lines.append("")
+    if numerics_results is None:
+        lines.append("(skipped — run without `--no-numerics`/`--no-trace` "
+                     "for the dtype census / cast-inventory / "
+                     "f32-residency gates; full per-entry tables: "
+                     "NUMERICS.md via `python scripts/precision_audit.py`)")
+    else:
+        bad = [r for r in numerics_results if not r.ok]
+        lines.append(f"- checks: {len(numerics_results)}, failing: "
+                     f"**{len(bad)}** (per-entry census + cast table + "
+                     "bf16 what-if: NUMERICS.md)")
+        lines.append("")
+        lines.append("| entry | check | status |")
+        lines.append("|---|---|---|")
+        for r in numerics_results:
             status = "ok" if r.ok else f"**FAIL** — {r.detail}"
             lines.append(f"| {r.entry} | {r.check} | {status} |")
     lines.append("")

@@ -323,6 +323,77 @@ _RULE_LIST = (
             "commit (entry-level rule — inline suppressions don't "
             "apply)",
     ),
+    Rule(
+        id="GL016",
+        name="low-precision-accumulation",
+        summary="add-based reduction / dot_general accumulation / psum "
+                "whose accumulator dtype is bf16/f16 at reduction "
+                "extent >= threshold",
+        rationale="bf16 has an 8-bit mantissa: summing N same-sign "
+                  "terms loses ~log2(N) of it, so a 256-term reduction "
+                  "keeps EFFECTIVELY zero fractional bits.  The MXU "
+                  "accumulates f32 natively — a bf16 accumulator is "
+                  "never a speed win, only a missing "
+                  "preferred_element_type=f32 (or an upcast dropped "
+                  "from a loss/psum chain).  Pass 5 "
+                  "(analysis/numerics.py) walks each entry's jaxpr and "
+                  "fires on every low-precision accumulation whose "
+                  "reduced extent crosses the threshold, so the bf16 "
+                  "what-if shows exactly which reductions must keep an "
+                  "f32 accumulator before anyone flips the model dtype.",
+        example="jnp.sum(x_bf16, axis=0)  # extent 4096, bf16 "
+                "accumulator",
+        fix="accumulate in f32: preferred_element_type=jnp.float32 on "
+            "the dot, or .astype(jnp.float32) before the sum/psum "
+            "(entry-level rule — a deliberate low-precision "
+            "accumulation is re-registered in analysis/numerics.py, "
+            "inline suppressions don't apply)",
+    ),
+    Rule(
+        id="GL017",
+        name="unstabilized-exp-domain",
+        summary="exp without a max-subtraction guard, or a reduce-sum "
+                "division without eps, in a loss module",
+        rationale="exp overflows f32 at x>88 and bf16 at x>88 with far "
+                  "coarser spacing; every softmax/logsumexp in the "
+                  "losses must subtract a running or global max before "
+                  "exponentiating (the online-softmax identity keeps "
+                  "this free), and every normalization that divides by "
+                  "a reduced sum needs an eps or max() floor.  The "
+                  "AST half of Pass 5 pattern-matches exp/division "
+                  "sites in losses/; the jaxpr half confirms the "
+                  "subtraction actually reaches the exp operand.  A "
+                  "deliberately-unguarded site (e.g. reference parity "
+                  "with the paper's unstabilized sum) carries an "
+                  "audited reason.",
+        example="neg = jnp.exp(pairwise).sum(axis=1)",
+        fix="subtract the row max (or reuse the logsumexp/online-"
+            "softmax guard) before exp; floor sum denominators with "
+            "eps or jnp.maximum; a deliberate site gets "
+            "# graftlint: disable=GL017(<why the domain is bounded>)",
+    ),
+    Rule(
+        id="GL018",
+        name="dtype-boundary-drift",
+        summary="an entry's dtype census (buffer bytes by dtype) or "
+                "cast inventory (named convert_element_type sites) "
+                "drifted from the pin",
+        rationale="Mixed precision only stays correct if every "
+                  "f32<->bf16 boundary is deliberate: an appearing "
+                  "cast is a new upconversion eating HBM (GL015's f32 "
+                  "BatchNorm finding), a vanishing cast is a loss "
+                  "accumulator silently demoted.  Pass 5 pins each "
+                  "entry's census and cast inventory the way Pass 2 "
+                  "pins collective multisets — drift lands as a "
+                  "readable named diff in tier-1, not as a loss curve "
+                  "divergence three days into a run.",
+        example="'f32->bf16 @ convert_element_type(state/params/...)' "
+                "vanishes from train_step_milnce",
+        fix="explain the moved boundary (NUMERICS.md names every "
+            "cast); if intended, re-pin EXPECTED_DTYPE_CENSUS / "
+            "EXPECTED_CASTS in the same commit (entry-level rule — "
+            "inline suppressions don't apply)",
+    ),
 )
 
 RULES: dict[str, Rule] = {r.id: r for r in _RULE_LIST}

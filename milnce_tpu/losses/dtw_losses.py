@@ -136,7 +136,7 @@ def sdtw_negative_loss(video_seq: jax.Array, text_seq: jax.Array,
     same_clip = clip_row[:, None] == clip_col[None, :]
     pairwise = jnp.where(same_clip, 0.0, pairwise)           # zero, not -inf:
     # parity with loss.py:84 (zeros still contribute exp(0)=1 to the sum)
-    negative = jnp.exp(pairwise).sum(axis=1).reshape(b, n).sum(axis=1)
+    negative = jnp.exp(pairwise).sum(axis=1).reshape(b, n).sum(axis=1)  # graftlint: disable=GL017(reference parity: loss.py:84 exponentiates raw frame dots, and cosine-normalized frames bound them in [-1,1] — exp stays under e)
     return jnp.mean(pos + negative / jnp.maximum(b - 1, 1))
 
 

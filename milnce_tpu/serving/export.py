@@ -186,6 +186,11 @@ def export_inference_checkpoint(out_dir: str, params, batch_stats,
                       "token_dict_path": token_dict},
         "video_shape": [int(d) for d in video_shape],
         "param_bytes": int(sum(v.nbytes for v in arrays.values())),
+        # per-array dtype manifest: the on-disk precision contract a
+        # loader (and scripts/precision_audit.py's quant-readiness
+        # report) can audit without opening the npz — float leaves are
+        # f32 by construction above, everything else ships as stored
+        "array_dtypes": {k: str(v.dtype) for k, v in arrays.items()},
     }
     with open(os.path.join(out_dir, METADATA_FILE), "w") as fh:
         json.dump(meta, fh, indent=2, sort_keys=True)

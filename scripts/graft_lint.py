@@ -8,6 +8,7 @@ Usage:
                                                       # (fast, no jax import)
     python scripts/graft_lint.py --no-concurrency # skip Pass 3 (GL010-012)
     python scripts/graft_lint.py --no-memplan     # skip Pass 4 (GL013-015)
+    python scripts/graft_lint.py --no-numerics    # skip Pass 5 (GL016-018)
     python scripts/graft_lint.py milnce_tpu/train # explicit scope
 
 Default scope is the ``milnce_tpu`` package — the library code that runs
@@ -59,6 +60,10 @@ def main(argv=None) -> int:
                     help="skip the static HBM planner pass (GL013-GL015 "
                          "peak/donation/contributor gates; implied by "
                          "--no-trace)")
+    ap.add_argument("--no-numerics", action="store_true",
+                    help="skip the numerics pass (GL016-GL018 dtype "
+                         "census / cast-inventory / f32-residency gates; "
+                         "implied by --no-trace)")
     ap.add_argument("--report", default=os.path.join(_REPO, "LINT.md"),
                     help="report path ('' to skip writing)")
     args = ap.parse_args(argv)
@@ -96,14 +101,26 @@ def main(argv=None) -> int:
         for r in mem_results:
             print(r.format())
 
+    numerics_results = None
+    if not args.no_trace and not args.no_numerics:
+        # Pass 5 audits the SAME traced programs Pass 4 just cached
+        # (memplan._traced_entry), so it costs walks, not traces
+        from milnce_tpu.analysis.numerics import run_numerics_checks
+
+        numerics_results = run_numerics_checks()
+        for r in numerics_results:
+            print(r.format())
+
     if args.report:
         with open(args.report, "w") as fh:
             fh.write(render_report(findings, trace_results, paths,
-                                   lock_graph, mem_results))
+                                   lock_graph, mem_results,
+                                   numerics_results))
         print(f"report: {args.report}")
 
     n_bad = (len(active) + sum(not r.ok for r in trace_results or [])
-             + sum(not r.ok for r in mem_results or []))
+             + sum(not r.ok for r in mem_results or [])
+             + sum(not r.ok for r in numerics_results or []))
     suppressed = sum(f.suppressed for f in findings)
     print(f"graftlint: {len(active)} finding(s), {suppressed} audited "
           f"suppression(s)"
@@ -112,6 +129,9 @@ def main(argv=None) -> int:
              f"failure(s)")
           + ("" if mem_results is None else
              f", {sum(not r.ok for r in mem_results)} memplan "
+             f"failure(s)")
+          + ("" if numerics_results is None else
+             f", {sum(not r.ok for r in numerics_results)} numerics "
              f"failure(s)"))
     return 1 if (args.check and n_bad) else 0
 

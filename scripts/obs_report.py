@@ -292,11 +292,16 @@ def check(current: dict, baseline: dict, tolerance: float) -> tuple[bool,
     # drift across layouts must be ATTRIBUTABLE — say so in the report
     # instead of letting a layout change read as a plain regression
     cur_doc, base_doc = current.get("doc") or {}, baseline.get("doc") or {}
-    for key in ("mesh", "sharding_map_hash"):
+    # dtype_census_hash: a differing precision fingerprint (Pass 5)
+    # means the two rows ran different-precision programs — the drift
+    # below is attributable to the dtype change, not the code under test
+    for key in ("mesh", "sharding_map_hash", "dtype_census_hash"):
         b, c = base_doc.get(key), cur_doc.get(key)
         if (b or c) and b != c:
+            kind = ("cross-precision" if key == "dtype_census_hash"
+                    else "cross-layout")
             lines.append(f"  [note] {key} differs: baseline {b or '-'} "
-                         f"-> current {c or '-'} (cross-layout compare)")
+                         f"-> current {c or '-'} ({kind} compare)")
     ok = True
     compared = 0
     for name in shared:

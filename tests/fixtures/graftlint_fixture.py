@@ -246,3 +246,4 @@ x_no_reason = 1  # graftlint: disable=GL001
 x_unknown_rule = 2  # graftlint: disable=GL999(no such rule)
 x_stale = 3  # graftlint: disable=GL001(fixture: stale — GL001 does not fire here)
 x_entry_level = 4  # graftlint: disable=GL013(planner rules pin entries, not source lines)
+x_entry_level_numerics = 5  # graftlint: disable=GL018(numerics rules pin entries, not source lines)

@@ -1,0 +1,34 @@
+"""Planted GL017 fixture (tests/test_numerics.py).
+
+Lives under a ``losses/`` path segment on purpose: the AST half of
+GL017 (analysis/astlint.py check_exp_stability) is scoped to loss
+modules.  Exactly ONE finding must fire here — the bare ``exp`` in
+``unguarded_softmax`` — and every guarded idiom below must stay silent,
+so the test pins both the hit and the non-hits.
+"""
+
+import jax.numpy as jnp
+
+
+def unguarded_softmax(scores):
+    # the planted GL017: exp over raw scores, no max-subtraction —
+    # overflows f32 as soon as a dot product exceeds ~88
+    weights = jnp.exp(scores)
+    return weights / (weights.sum(axis=-1, keepdims=True) + 1e-6)
+
+
+def guarded_softmax(scores):
+    # silent: the house idiom — subtract the row max before exp
+    row_max = jnp.max(scores, axis=-1, keepdims=True)
+    weights = jnp.exp(scores - row_max)
+    return weights / (weights.sum(axis=-1, keepdims=True) + 1e-6)
+
+
+def guarded_via_lse(scores, row_lse):
+    # silent: subtracting a logsumexp-derived name is a guard reference
+    return jnp.exp(scores - row_lse)
+
+
+def masked_mean(values, mask):
+    # silent: the denominator has a maximum floor, not a bare sum
+    return (values * mask).sum() / jnp.maximum(mask.sum(), 1.0)

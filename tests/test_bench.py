@@ -79,6 +79,16 @@ class TestMakeRecord:
         rec = bench._make_record(self.BEST, 16, 224, True, "TPU v5 lite")
         assert "predicted_peak_bytes_per_chip" not in rec
 
+    def test_dtype_census_hash_rides_into_the_obs_record(self):
+        # Pass 5: the precision fingerprint is how obs_report tells a
+        # dtype change from a speedup — best-effort, so an errored
+        # audit ships without the field, never with a fake hash
+        best = dict(self.BEST, dtype_census_hash="abc123def456")
+        rec = bench._make_record(best, 16, 224, True, "TPU v5 lite")
+        assert rec["dtype_census_hash"] == "abc123def456"
+        rec = bench._make_record(self.BEST, 16, 224, True, "TPU v5 lite")
+        assert "dtype_census_hash" not in rec
+
 
 def test_wedge_truncation_marks_partial(monkeypatch):
     """A config timeout followed by a dead re-probe must stop the sweep
@@ -252,6 +262,9 @@ class TestConfigChild:
         # ISSUE 8: every measured row carries its static HBM plan, and
         # the 2-D row's per-chip prediction reflects the FSDP sharding
         assert r["predicted_peak_bytes_per_chip"] > 0
+        # Pass 5: and its precision fingerprint, so obs_report can flag
+        # cross-precision compares
+        assert len(r["dtype_census_hash"]) == 12
         json.dumps(r)
 
     def test_mesh_2d_row_refuses_pure_replication(self, monkeypatch):

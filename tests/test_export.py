@@ -86,6 +86,19 @@ def test_metadata_contract(export_dir):
     assert meta["model"]["embedding_dim"] == 16
     assert meta["model"]["word2vec_path"] == ""         # sanitized
     assert meta["step"] == 0 and meta["param_bytes"] > 0
+    # dtype manifest: one entry per shipped array, float leaves f32 by
+    # construction (bf16 is a load-time cast), and the manifest must
+    # agree with the npz it describes — the precision contract
+    # scripts/precision_audit.py's quant-readiness report audits
+    from milnce_tpu.serving.export import ARRAYS_FILE
+
+    dtypes = meta["array_dtypes"]
+    with np.load(os.path.join(export_dir, ARRAYS_FILE)) as z:
+        assert sorted(dtypes) == sorted(z.files)
+        for key in z.files:
+            assert dtypes[key] == str(z[key].dtype), key
+    assert all(v == "float32" for k, v in dtypes.items()
+               if v.startswith("float")), dtypes
 
 
 def test_no_optimizer_state_ships(export_dir):

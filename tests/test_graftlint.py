@@ -33,7 +33,8 @@ def test_fixture_violates_every_rule_exactly_once():
                      if not f.suppressed)
     assert active == {
         # missing reason + unknown rule + stale + entry-level (GL013)
-        "GL000": 4,
+        # + entry-level numerics (GL018)
+        "GL000": 5,
         "GL001": 1, "GL002": 1, "GL003": 1,
         "GL004": 1, "GL005": 1, "GL006": 1, "GL007": 1, "GL008": 1,
         "GL009": 1, "GL010": 1, "GL011": 1, "GL012": 1,
@@ -89,19 +90,21 @@ def test_rule_registry_is_consistent():
     assert set(RULES) == {"GL000", "GL001", "GL002", "GL003", "GL004",
                           "GL005", "GL006", "GL007", "GL008", "GL009",
                           "GL010", "GL011", "GL012", "GL013", "GL014",
-                          "GL015"}
+                          "GL015", "GL016", "GL017", "GL018"}
     assert len(RULES_BY_NAME) == len(RULES), "duplicate rule names"
     for rule in RULES.values():
         assert rule.summary and rule.rationale and rule.fix
 
 
 def test_entry_level_rule_suppression_is_gl000():
-    """GL013-GL015 (the Pass 4 planner rules) attach to registered
-    trace entries, never source lines — an inline suppression can't
-    match anything, so writing one is itself a GL000 with the re-pin
-    route named (the stale-suppression audit extended to the rules
-    that cannot fire here)."""
-    for rule_id in ("GL013", "GL014", "GL015"):
+    """GL013-GL015 (Pass 4) and GL016/GL018 (Pass 5) attach to
+    registered trace entries, never source lines — an inline
+    suppression can't match anything, so writing one is itself a GL000
+    with the re-pin route named (the stale-suppression audit extended
+    to the rules that cannot fire here).  GL017 is the exception: its
+    AST half fires on source lines in losses/, so it stays inline-
+    suppressible."""
+    for rule_id in ("GL013", "GL014", "GL015", "GL016", "GL018"):
         findings = lint_source(
             f"y = 1  # graftlint: disable={rule_id}(some reason)\n")
         assert [f.rule.id for f in findings] == ["GL000"], rule_id
@@ -110,6 +113,13 @@ def test_entry_level_rule_suppression_is_gl000():
     (f,) = lint_source("y = 1  # graftlint: disable="
                        "peak-budget-regression(reason)\n")
     assert f.rule.id == "GL000" and "memplan" in f.message
+    # GL017 IS inline-suppressible where it fires (a losses/ module)
+    src = ("import jax.numpy as jnp\n"
+           "def f(s):\n"
+           "    return jnp.exp(s)  "
+           "# graftlint: disable=GL017(domain bounded by construction)\n")
+    (f,) = lint_source(src, "milnce_tpu/losses/fake.py")
+    assert f.rule.id == "GL017" and f.suppressed
 
 
 def test_duplicate_nested_names_are_all_linted():

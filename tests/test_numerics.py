@@ -1,0 +1,257 @@
+"""graftlint Pass 5 gates: the precision-flow audit (analysis/numerics.py).
+
+Four layers, the same discipline as the Pass 4 suite:
+
+- **unit**: dtype-flow corner cases — the census counts bytes by dtype,
+  GL016 prices reduction extents, the cast inventory names boundaries.
+- **parity**: the audit's tolerance claim checked against reality — the
+  f32 and bf16 milnce losses agree within the bound derived from the
+  audited reduction extent (eps(bf16) x extent), so the what-if table's
+  "bf16 costs you this much accuracy" framing is calibrated, not vibes.
+- **planted failures**: each of GL016/GL017/GL018 fires exactly once on
+  a planted regression — a detector that can't fail is decoration.
+- **the gate**: every registered entry audits green against the pins
+  (census + cast inventory + f32 residency), with the pin-table and
+  entry coverage floors asserted — the tier-1 check the tentpole
+  exists for.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from milnce_tpu.analysis import numerics
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---- unit: the dtype-flow walk -------------------------------------------
+
+def test_census_counts_bytes_by_dtype():
+    def mixed(x, idx):
+        return x.sum() + idx.astype(jnp.float32).sum()
+
+    audit = numerics.audit_fn(
+        mixed, (jax.ShapeDtypeStruct((1024,), jnp.float32),
+                jax.ShapeDtypeStruct((256,), jnp.int32)),
+        argnames=("x", "idx"))
+    # args alone: 4 KB f32 + 1 KB i32; outputs/temps add f32 bytes only
+    assert audit.census["f32"] >= 1024 * 4
+    assert audit.census["i32"] >= 256 * 4
+    assert "i32->f32 @ idx" in audit.casts, audit.casts
+
+
+def test_census_hash_moves_with_precision_not_with_values():
+    """The bench-record identity: same program -> same hash; the SAME
+    program at bf16 -> a different hash (cross-precision compares must
+    be flaggable from the record alone)."""
+    def dot(a, b):
+        return a @ b
+
+    def args(dt):
+        return (jax.ShapeDtypeStruct((8, 128), dt),
+                jax.ShapeDtypeStruct((128, 8), dt))
+
+    h32a = numerics.audit_fn(dot, args(jnp.float32)).census_hash()
+    h32b = numerics.audit_fn(dot, args(jnp.float32)).census_hash()
+    h16 = numerics.audit_fn(dot, args(jnp.bfloat16)).census_hash()
+    assert h32a == h32b
+    assert h32a != h16
+
+
+# ---- parity: the bf16 tolerance claim vs reality -------------------------
+
+def parity_tolerance(dtype, extent: int, safety: float = 4.0) -> float:
+    """The audit-derived agreement bound: one rounding step per element
+    of the largest low-precision reduction, rms-accumulated
+    (eps x sqrt(extent)), with a safety factor for the exp/log
+    nonlinearity around the reduction."""
+    eps = float(jnp.finfo(dtype).eps)
+    return eps * float(np.sqrt(extent)) * safety
+
+
+def test_f32_vs_bf16_milnce_loss_within_audited_tolerance():
+    """The what-if table says bf16 demotes the logsumexp reductions; the
+    parity bound derived from that audited extent must hold on real
+    values — and a bound 100x tighter must NOT (the tolerance is a
+    measurement, not slack)."""
+    from milnce_tpu.losses.milnce import milnce_loss
+
+    b, k, d = 8, 4, 16
+    rng = np.random.default_rng(0)
+    video = rng.standard_normal((b, d)).astype(np.float32)
+    text = rng.standard_normal((b * k, d)).astype(np.float32)
+
+    loss32 = float(milnce_loss(jnp.asarray(video), jnp.asarray(text)))
+    loss16 = float(milnce_loss(jnp.asarray(video, jnp.bfloat16),
+                               jnp.asarray(text, jnp.bfloat16)))
+    # the denominator lse concatenates row + column cubes: 2*B*K terms,
+    # on top of a D-deep bf16 dot contraction
+    extent = 2 * b * k * d
+    tol = parity_tolerance(jnp.bfloat16, extent)
+    assert abs(loss32 - loss16) <= tol * max(1.0, abs(loss32)), (
+        f"f32 {loss32} vs bf16 {loss16} outside audited tolerance {tol}")
+    # f32-vs-f32 determinism sanity: the bound is about precision, not
+    # run-to-run noise
+    again = float(milnce_loss(jnp.asarray(video), jnp.asarray(text)))
+    assert loss32 == again
+
+
+# ---- planted failures: each rule fires exactly once ----------------------
+
+def test_gl016_fires_once_on_planted_bf16_accumulation():
+    def dot(a, b):
+        return a @ b
+
+    args16 = (jax.ShapeDtypeStruct((8, 128), jnp.bfloat16),
+              jax.ShapeDtypeStruct((128, 8), jnp.bfloat16))
+    audit = numerics.audit_fn(dot, args16)
+    assert len(audit.gl016_sites) == 1, audit.gl016_sites
+    assert "contraction 128" in audit.gl016_sites[0]
+    # and the check turns the site into exactly one failing result
+    bad = [r for r in (numerics._check_gl016("planted", audit),)
+           if not r.ok]
+    assert len(bad) == 1 and "EXPECTED_GL016" in bad[0].detail
+
+    # the f32 twin is silent
+    args32 = (jax.ShapeDtypeStruct((8, 128), jnp.float32),
+              jax.ShapeDtypeStruct((128, 8), jnp.float32))
+    assert numerics.audit_fn(dot, args32).gl016_sites == ()
+
+    # below the extent threshold: a tiny bf16 dot is noise, not a finding
+    small = (jax.ShapeDtypeStruct((8, 16), jnp.bfloat16),
+             jax.ShapeDtypeStruct((16, 8), jnp.bfloat16))
+    assert numerics.audit_fn(dot, small).gl016_sites == ()
+
+
+def test_gl017_fires_once_on_planted_fixture():
+    """The AST half, on the fixture under tests/fixtures/losses/ (the
+    path gate is part of the contract: GL017 is scoped to loss
+    modules): exactly ONE finding — the bare exp — while the guarded
+    softmax/lse/eps-floor idioms beside it stay silent."""
+    from milnce_tpu.analysis.astlint import lint_paths
+
+    fixture = os.path.join(_REPO, "tests", "fixtures", "losses",
+                           "gl017_fixture.py")
+    findings = [f for f in lint_paths([fixture]) if not f.suppressed]
+    gl017 = [f for f in findings if f.rule.id == "GL017"]
+    assert len(gl017) == 1, [f.format() for f in findings]
+    assert gl017[0].line == 16  # the bare jnp.exp(scores)
+    assert [f for f in findings if f.rule.id != "GL017"] == []
+
+
+def test_gl017_jaxpr_half_counts_unguarded_exp():
+    arg = (jax.ShapeDtypeStruct((64,), jnp.float32),)
+
+    def guarded(x):
+        return jnp.exp(x - x.max()).sum()
+
+    assert numerics.audit_fn(guarded, arg).exp_sites == ()
+
+    # exp directly of an ENTRY ARG reads guarded by the boundary rule
+    # (the guard may live a level up), so the planted site routes
+    # through an unbounded producer: exp(x + x) -> exactly one site
+    def raw(x):
+        return jnp.exp(x + x).sum()
+
+    audit_raw = numerics.audit_fn(raw, arg)
+    assert len(audit_raw.exp_sites) == 1, audit_raw.exp_sites
+    bad = numerics._check_gl017("planted", audit_raw)
+    assert not bad.ok and "EXPECTED_UNGUARDED_EXP" in bad.detail
+
+
+def test_gl018_census_fires_once_on_planted_drift(monkeypatch):
+    audits = numerics.audit_all(["milnce_loss_dense"])
+    real = dict(audits["milnce_loss_dense"].census)
+    real["f32"] = real.get("f32", 0) + 12345
+    monkeypatch.setitem(numerics.EXPECTED_DTYPE_CENSUS,
+                        "milnce_loss_dense", real)
+    results = numerics.run_numerics_checks(["milnce_loss_dense"],
+                                           audits=audits)
+    bad = [r for r in results if not r.ok]
+    assert [r.check for r in bad] == ["GL018-dtype-census"], (
+        [r.format() for r in results])
+    assert "re-pin" in bad[0].detail
+
+
+def test_gl018_cast_inventory_fires_once_on_planted_boundary(monkeypatch):
+    audits = numerics.audit_all(["milnce_loss_dense"])
+    planted = dict(audits["milnce_loss_dense"].casts)
+    planted["f32->bf16 @ phantom_boundary"] = 1
+    monkeypatch.setitem(numerics.EXPECTED_CASTS, "milnce_loss_dense",
+                        planted)
+    results = numerics.run_numerics_checks(["milnce_loss_dense"],
+                                           audits=audits)
+    bad = [r for r in results if not r.ok]
+    assert [r.check for r in bad] == ["GL018-cast-inventory"], (
+        [r.format() for r in results])
+    assert "phantom_boundary" in bad[0].detail
+
+
+def test_entry_name_filter_rejects_typos():
+    with pytest.raises(ValueError, match="unknown numerics entries"):
+        numerics.audit_all(["train_step_milcne"])
+    with pytest.raises(ValueError, match="unknown numerics entries"):
+        numerics.run_numerics_checks(["no_such_entry"])
+
+
+# ---- the what-if axis ----------------------------------------------------
+
+def test_bf16_what_if_names_the_demotions():
+    """The static half of the mixed-precision decision at the tiny
+    preset: flipping the model dtype must surface low-precision
+    accumulations AND log-domain residency violations, while the f32
+    twin stays clean — the NUMERICS.md what-if table's content."""
+    a32 = numerics.what_if_audit(batch=16, frames=4, size=32, words=6,
+                                 k=2, dtype="float32", preset="tiny")
+    a16 = numerics.what_if_audit(batch=16, frames=4, size=32, words=6,
+                                 k=2, dtype="bfloat16", preset="tiny")
+    assert a32.gl016_sites == ()
+    assert a32.residency_violations == ()
+    assert len(a16.gl016_sites) > 0
+    assert any("bf16" in s or "bfloat16" in s for s in a16.gl016_sites)
+    assert a16.census.get("bf16", 0) > 0
+    assert a32.census.get("bf16", 0) == 0
+
+
+# ---- the gate ------------------------------------------------------------
+
+def test_all_registered_entries_audit_green():
+    """The Pass 5 merge gate: GL016 + GL017 + GL018 + f32-residency hold
+    for every registered entry, with the coverage floor asserted."""
+    results = numerics.run_numerics_checks()
+    bad = [r.format() for r in results if not r.ok]
+    assert not bad, "numerics invariants violated:\n" + "\n".join(bad)
+    entries = {r.entry for r in results}
+    assert {"train_step_milnce", "train_step_milnce_guarded",
+            "train_step_sdtw3", "grad_cache_step_milnce",
+            "train_step_milnce_chunked", "milnce_loss_dense",
+            "milnce_loss_chunked", "train_step_milnce_2d",
+            "grad_cache_2d", "serve_text_embed@b0", "serve_video_embed@b1",
+            "serve_index_topk", "serve_index_topk@gen",
+            "train_step_curriculum@s1"} <= entries
+    # every entry carries all five checks
+    for entry in entries:
+        checks = {r.check for r in results if r.entry == entry}
+        assert {"GL016-low-precision-accum", "GL017-exp-domain",
+                "GL018-dtype-census", "GL018-cast-inventory",
+                "f32-residency"} <= checks, (entry, checks)
+    # train entries actually audit a nonempty residency set (BN stats +
+    # optimizer moments) — an empty set would make the rule vacuous
+    audit = numerics.audit_entry("train_step_milnce")
+    assert len(audit.f32_residency) > 0
+
+
+def test_pin_tables_cover_every_registered_entry():
+    """Unpinned entries fail the gate as 'entry unpinned', so the pin
+    tables and the registry must move together — this is the coverage
+    floor that keeps a new entry from shipping censusless."""
+    names = set(numerics.entry_names())
+    assert set(numerics.EXPECTED_DTYPE_CENSUS) == names, (
+        names ^ set(numerics.EXPECTED_DTYPE_CENSUS))
+    assert set(numerics.EXPECTED_CASTS) == names, (
+        names ^ set(numerics.EXPECTED_CASTS))

@@ -472,6 +472,20 @@ class TestObsReport:
                                 "--baseline", str(base))
         assert code == 0 and "[note]" not in out
 
+    def test_gate_notes_cross_precision_compare(self, tmp_path):
+        # Pass 5: a differing dtype_census_hash means the rows ran
+        # different-precision programs — attributable, not a regression
+        base = tmp_path / "base.json"
+        base.write_text(json.dumps({**_serve_doc(),
+                                    "dtype_census_hash": "f33cda64207f"}))
+        cur = tmp_path / "cur.json"
+        cur.write_text(json.dumps({**_serve_doc(),
+                                   "dtype_census_hash": "0123abcd4567"}))
+        code, out = _run_report("--check", str(cur),
+                                "--baseline", str(base))
+        assert code == 0, out
+        assert "[note] dtype_census_hash differs" in out
+
     def test_incomparable_artifacts_fail_loudly(self, tmp_path):
         empty = tmp_path / "empty.jsonl"
         empty.write_text(json.dumps({"kind": "event", "name": "e",

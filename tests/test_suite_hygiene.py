@@ -128,6 +128,7 @@ _REPORT_GENERATORS = {
     "LINT.md": "scripts/graft_lint.py",
     "MEMPLAN.md": "scripts/mem_plan.py",
     "BENCH_MILNCE_LOSS.md": "scripts/milnce_loss_bench.py",
+    "NUMERICS.md": "scripts/precision_audit.py",
 }
 
 
@@ -165,6 +166,8 @@ def test_report_writers_emit_generator_headers():
             "auto-written by scripts/mem_plan.py",
         os.path.join(_REPO, "scripts", "milnce_loss_bench.py"):
             "auto-written by scripts/milnce_loss_bench.py",
+        os.path.join(_REPO, "scripts", "precision_audit.py"):
+            "auto-written by scripts/precision_audit.py",
     }
     for path, header in writers.items():
         assert header in open(path).read(), (
@@ -180,7 +183,8 @@ def test_report_writers_emit_generator_headers():
 # re-ships the /healthz-dict class of race).
 _ANALYSIS_GATES = ("test_graftlint.py", "test_graftlint_concurrency.py",
                    "test_lockrt.py", "test_trace_invariants.py",
-                   "test_transfer_guard.py", "test_memplan.py")
+                   "test_transfer_guard.py", "test_memplan.py",
+                   "test_numerics.py")
 
 
 def test_analysis_gates_exist_and_stay_tier1():
