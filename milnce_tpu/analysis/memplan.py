@@ -672,6 +672,48 @@ def _e_pool_serve(entry: str, bucket_idx: int):
     return build
 
 
+@functools.lru_cache(maxsize=1)
+def _serve_quant_engine():
+    """Cold QUANTIZED engine (ISSUE 19): the tiny model's weights int8
+    per the readiness rule, behind the same ladder.  The plan prices
+    what the edge tier buys — int8 param residency (4x smaller leaves)
+    against the in-jit dequantize's transient f32 copies; the pins keep
+    that trade visible, so a dequant that started materializing the
+    whole f32 tree at once shows up as GL013/GL015 drift."""
+    import jax
+
+    from milnce_tpu.analysis.trace_invariants import (_FRAMES, _SIZE,
+                                                      _WORDS, _setup)
+    from milnce_tpu.quant.quantize import (QuantizedModel,
+                                           quantize_variables)
+    from milnce_tpu.serving.engine import InferenceEngine
+
+    model, _opt, mesh, state, _batch = _setup()
+    varz = {"params": state.params, "batch_stats": state.batch_stats}
+    qvarz = quantize_variables(varz)
+    ndev = len(jax.devices())
+    engine = InferenceEngine(QuantizedModel(model), qvarz, mesh,
+                             text_words=_WORDS,
+                             video_shape=(_FRAMES, _SIZE, _SIZE, 3),
+                             max_batch=2 * ndev, precompile=False)
+    return engine, qvarz
+
+
+def _e_quant_serve(entry: str, bucket_idx: int):
+    def build():
+        import numpy as np
+
+        from milnce_tpu.analysis.trace_invariants import _FRAMES, _SIZE, _WORDS
+
+        engine, qvarz = _serve_quant_engine()
+        fn = engine.jit_entries()[entry]
+        b = engine.buckets[bucket_idx]
+        x = (np.zeros((b, _WORDS), np.int32) if entry == "text"
+             else np.zeros((b, _FRAMES, _SIZE, _SIZE, 3), np.uint8))
+        return fn, (qvarz, x)
+    return build
+
+
 def _e_index_topk():
     def build():
         import jax
@@ -761,6 +803,10 @@ def _entries() -> dict:
                  argnames=("variables", "tokens"), mesh="1x1 replica"),
         MemEntry("serve_pool_video_embed@b1", _e_pool_serve("video", 1),
                  argnames=("variables", "video"), mesh="1x1 replica"),
+        MemEntry("serve_quant_text_embed@b1", _e_quant_serve("text", 1),
+                 argnames=("variables", "tokens")),
+        MemEntry("serve_quant_video_embed@b1", _e_quant_serve("video", 1),
+                 argnames=("variables", "video")),
     )}
 
 
@@ -809,6 +855,15 @@ EXPECTED_PEAK_BYTES = {
     # over 8 chips — byte-identical), never N-replicas-times-anything
     "serve_pool_text_embed@b0": 2119592,
     "serve_pool_video_embed@b1": 2888640,
+    # quantized edge engine (ISSUE 19): int8 residency vs dequant
+    # transients, both legible in the numbers.  The text entry drops to
+    # ~0.5x the f32 engine's peak (params live as int8; only the text
+    # tower's few kernels dequantize, transiently).  The video entry
+    # pays ~1.2x: the conv kernels' f32 dequant copies (the GL015 `mul`
+    # names) overlap the activation peak — the expected trade (the edge
+    # class buys HBM residency and PCIe bytes, not peak-transient)
+    "serve_quant_text_embed@b1": 986108,
+    "serve_quant_video_embed@b1": 3026132,
 }
 
 # Pinned top-3 peak contributors per entry (GL015), by aggregated label:
@@ -890,6 +945,18 @@ EXPECTED_TOP_CONTRIBUTORS = {
         "variables/params/conv_2c/conv_spatial/kernel",
         "variables/params/conv_2c/conv_temporal/kernel",
         "variables/params/mixed_3b/conv_b1_b/conv_spatial/kernel"),
+    # quant entries: the top contributors ARE the dequant story — the
+    # text peak sits at one kernel's i8->f32 convert beside the int8
+    # residents; the video peak at the three largest kernels' scale
+    # `mul` outputs (the f32 copies that feed the convs)
+    "serve_quant_text_embed@b1": (
+        "convert_element_type float32[1,3,3,64,192]",
+        "variables/params/conv_2c/conv_spatial/kernel",
+        "variables/params/conv_2c/conv_temporal/kernel"),
+    "serve_quant_video_embed@b1": (
+        "mul float32[1,3,3,64,192]",
+        "mul float32[1,3,3,96,128]",
+        "mul float32[3,1,1,192,192]"),
 }
 
 

@@ -443,6 +443,16 @@ EXPECTED_DTYPE_CENSUS = {
     "serve_index_topk@gen": {"f32": 4164, "i32": 1520, "bool": 60},
     "serve_pool_text_embed@b0": {"f32": 2121664, "i32": 160, "bool": 10},
     "serve_pool_video_embed@b1": {"f32": 12138176, "u8": 49152},
+    # quantized edge engine (ISSUE 19): the i8 bucket IS the resident
+    # weight tree (21 quantized leaves of the tiny model), f32 covers
+    # the dequant copies + activations.  GL016-clean by construction:
+    # int8 is a STORAGE dtype here — every dot_general runs on the
+    # dequantized f32 operands, so no low-precision accumulator exists
+    # for the rule to fire on (the ISSUE 19 w8/f32-accum contract)
+    "serve_quant_text_embed@b1": {
+        "f32": 2124308, "i8": 524992, "i32": 440, "bool": 10},
+    "serve_quant_video_embed@b1": {
+        "f32": 9241364, "i8": 524992, "u8": 196608},
     "train_step_curriculum@s1": {
         "i32": 592, "f32": 81928876, "u8": 393216, "bool": 430550},
 }
@@ -505,6 +515,51 @@ EXPECTED_CASTS = {
     "serve_index_topk@gen": {"f32->f32 @ nest-boundary": 1},
     "serve_pool_text_embed@b0": {},
     "serve_pool_video_embed@b1": {"u8->f32 @ video": 1},
+    # quant entries: exactly ONE named i8->f32 route per quantized leaf
+    # — the dequant boundary inventory.  A vanished route is a weight
+    # silently left f32 in the artifact; an extra one is a leaf the
+    # readiness rule stopped protecting.  Both towers dequantize the
+    # FULL tree (the jit entry binds the whole variables arg; XLA DCEs
+    # the unused tower's convs post-trace, but the traced program —
+    # what this pass audits — carries every route).
+    "serve_quant_text_embed@b1": dict.fromkeys([
+        f"i8->f32 @ variables/params/{k}" for k in (
+            "conv1/conv/kernel", "conv_2b/conv/kernel",
+            "conv_2c/conv_spatial/kernel", "conv_2c/conv_temporal/kernel",
+            "fc/kernel", "gating/fc/kernel",
+            "mixed_3b/conv_b0/conv/kernel",
+            "mixed_3b/conv_b1_a/conv/kernel",
+            "mixed_3b/conv_b1_b/conv_spatial/kernel",
+            "mixed_3b/conv_b1_b/conv_temporal/kernel",
+            "mixed_3b/conv_b2_a/conv/kernel",
+            "mixed_3b/conv_b2_b/conv_spatial/kernel",
+            "mixed_3b/conv_b2_b/conv_temporal/kernel",
+            "mixed_3b/conv_b3_b/conv/kernel",
+            "mixed_3b/gating_b0/fc/kernel",
+            "mixed_3b/gating_b1/fc/kernel",
+            "mixed_3b/gating_b2/fc/kernel",
+            "mixed_3b/gating_b3/fc/kernel",
+            "text_module/fc1/kernel", "text_module/fc2/kernel",
+            "text_module/word_embd/embedding")], 1),
+    "serve_quant_video_embed@b1": dict.fromkeys(["u8->f32 @ video"] + [
+        f"i8->f32 @ variables/params/{k}" for k in (
+            "conv1/conv/kernel", "conv_2b/conv/kernel",
+            "conv_2c/conv_spatial/kernel", "conv_2c/conv_temporal/kernel",
+            "fc/kernel", "gating/fc/kernel",
+            "mixed_3b/conv_b0/conv/kernel",
+            "mixed_3b/conv_b1_a/conv/kernel",
+            "mixed_3b/conv_b1_b/conv_spatial/kernel",
+            "mixed_3b/conv_b1_b/conv_temporal/kernel",
+            "mixed_3b/conv_b2_a/conv/kernel",
+            "mixed_3b/conv_b2_b/conv_spatial/kernel",
+            "mixed_3b/conv_b2_b/conv_temporal/kernel",
+            "mixed_3b/conv_b3_b/conv/kernel",
+            "mixed_3b/gating_b0/fc/kernel",
+            "mixed_3b/gating_b1/fc/kernel",
+            "mixed_3b/gating_b2/fc/kernel",
+            "mixed_3b/gating_b3/fc/kernel",
+            "text_module/fc1/kernel", "text_module/fc2/kernel",
+            "text_module/word_embd/embedding")], 1),
     "train_step_curriculum@s1": {
         "u8->f32 @ video": 1, "bool->f32 @ eq": 4,
         "i32->f32 @ state/opt_state/hyperparams_states/learning_rate/count": 1,

@@ -138,6 +138,14 @@ EXPECTED_COLLECTIVES = {
     # top-k program — identical pinned communication, whatever
     # generation is live
     "serve_live_index": {"all_gather": 2},
+    # quantized edge tier (ISSUE 19): the int8 engine is the same embed
+    # program with an in-jit dequantize prologue (i8 -> f32 convert +
+    # scale multiply, quant/quantize.py) — it must stay collective-free
+    # like every other embed entry, and GL016-clean by construction:
+    # every matmul accumulates in f32 because the ONLY low-precision
+    # dtype in the program is int8 storage, never a compute dtype
+    "serve_quant_text_embed": {},
+    "serve_quant_video_embed": {},
 }
 
 
@@ -888,6 +896,53 @@ def _entry_serve_pool_embed() -> list[CheckResult]:
         pool.close()
 
 
+def _entry_serve_quant_embed_ladder() -> list[CheckResult]:
+    """Quantized edge engine (ISSUE 19): the int8 tower behind the SAME
+    bucket ladder — quantize the tiny model per the readiness rule, run
+    the full post-warmup sweep (every rung plus pad-path sizes), and
+    require zero new jit-cache entries; then pin both entries' jaxprs
+    collective-free.  The in-jit dequantize must change neither the
+    recompile story nor the communication structure — that is what makes
+    a quantized export a drop-in replica class in a mixed pool."""
+    import numpy as np
+
+    from milnce_tpu.quant.quantize import (QuantizedModel,
+                                           quantize_variables)
+    from milnce_tpu.serving.engine import InferenceEngine
+
+    model, _opt, mesh, state, _batch = _setup()
+    varz = {"params": state.params, "batch_stats": state.batch_stats}
+    qvarz = quantize_variables(varz)
+    qmodel = QuantizedModel(model)
+    import jax
+
+    ndev = len(jax.devices())
+    engine = InferenceEngine(qmodel, qvarz, mesh, text_words=_WORDS,
+                             video_shape=(_FRAMES, _SIZE, _SIZE, 3),
+                             max_batch=2 * ndev)   # 2-rung ladder
+    rng = np.random.default_rng(0)
+    sizes = list(engine.buckets) + [1, engine.buckets[0] + 1]  # pad paths
+    for n in sizes:
+        engine.embed_text(rng.integers(
+            0, _TINY["vocab_size"], (n, _WORDS)).astype(np.int32))
+        engine.embed_video(rng.integers(
+            0, 255, (n, _FRAMES, _SIZE, _SIZE, 3), dtype=np.uint8))
+    n_re = engine.recompiles()
+    out = [CheckResult(
+        "serve_quant_embed_ladder", "recompile", n_re == 0,
+        "" if n_re == 0 else f"{n_re} jit-cache entries appeared AFTER "
+        "the warmup bucket sweep on the QUANTIZED engine — the dequant "
+        "prologue is destabilizing the jit cache (scales tree drift?)")]
+    b = engine.buckets[-1]
+    entries = engine.jit_entries()      # the supported analysis surface
+    out += _jaxpr_checks("serve_quant_text_embed", entries["text"],
+                         (qvarz, np.zeros((b, _WORDS), np.int32)))
+    out += _jaxpr_checks("serve_quant_video_embed", entries["video"],
+                         (qvarz, np.zeros((b, _FRAMES, _SIZE, _SIZE, 3),
+                                          np.uint8)))
+    return out
+
+
 def _entry_serve_index_topk() -> list[CheckResult]:
     """Sharded retrieval: exactly 2 all_gathers (the (Q, k) score and
     index candidate lists), no f64, and the double-call recompile check
@@ -982,6 +1037,7 @@ ENTRY_POINTS = {
     "softdtw_scan": _entry_softdtw_scan,
     "param_treedef": _entry_param_treedef,
     "serve_embed_ladder": _entry_serve_embed_ladder,
+    "serve_quant_embed_ladder": _entry_serve_quant_embed_ladder,
     "serve_index_topk": _entry_serve_index_topk,
     "serve_pool_embed": _entry_serve_pool_embed,
     "serve_live_index": _entry_serve_live_index,

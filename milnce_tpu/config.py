@@ -481,7 +481,21 @@ class ServeConfig:
                                         # rung floor (0 = sized by k and
                                         # the boot corpus; raise it to
                                         # pre-provision headroom so early
-                                        # growth never crosses a rung)
+                                        # growth never crosses a rung).
+                                        # HowTo100M-scale default: 524288
+                                        # (= 2**19; ~1.2M corpus rows /
+                                        # 8-way data axis x 2 headroom —
+                                        # recommended_min_shard_rows() in
+                                        # serving/live_index.py computes
+                                        # the rung for other corpora)
+    edge_export_dir: str = ""           # quantized/student artifact the
+                                        # edge replica class serves
+                                        # (SERVING.md "Edge tier");
+                                        # '' = no edge tier
+    edge_replicas: int = 0              # edge-class replicas added to the
+                                        # pool beside the f32 replicas;
+                                        # requests pin a class via the
+                                        # 'replica_class' field
 
 
 @dataclass

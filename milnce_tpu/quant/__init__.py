@@ -1,0 +1,22 @@
+"""Edge-tier quantization subsystem (ROADMAP item 5).
+
+Three pieces, layered on the serving export family:
+
+- :mod:`milnce_tpu.quant.quantize` — weight-only symmetric int8
+  post-training quantization (per-tensor or per-channel scales chosen
+  by the NUMERICS.md readiness rule) plus the duck-typed
+  ``QuantizedModel`` wrapper the serving engine runs unchanged.
+- :mod:`milnce_tpu.quant.calibrate` — the calibration pass: activation
+  ranges over held-out clips/captions, embedding-space quality stats
+  vs the f32 teacher, and the NUMERICS.md verdict reader that seeds
+  the per-channel key set.
+- :mod:`milnce_tpu.quant.distill` — the distilled student text tower
+  (frozen word table + thinner MLP trained against frozen teacher
+  embeddings), grafted back into a full-model variables tree so it
+  exports/serves through the exact same machinery.
+"""
+
+from milnce_tpu.quant.quantize import (  # noqa: F401
+    OUTLIER_FRACTION, PER_CHANNEL_RATIO, QUANT_SCHEME, QuantizedModel,
+    dequantize_array, dequantize_params, quantize_array,
+    quantize_variables, weight_readiness_row)

@@ -1,0 +1,201 @@
+"""Post-training calibration pass for the int8 edge tier.
+
+``calibrate_and_quantize`` is the offline entry: it quantizes a
+{'params', 'batch_stats'} f32 tree with per-channel scales seeded from
+the COMMITTED NUMERICS.md readiness verdicts (the
+scripts/precision_audit.py table — single rule source in
+quant/quantize.py), runs held-out clips/captions through both towers
+to record per-layer activation absmax ranges, and measures the
+embedding-space damage (cosine to the f32 teacher, top-k rank
+agreement) that the export's ``quant.calibration`` metadata block then
+carries — so a serving host can audit what a quantized artifact cost
+WITHOUT re-running calibration.
+
+Activation ranges are collected with flax ``capture_intermediates``:
+weight-only int8 doesn't need them to serve (no activation is ever
+quantized), but they are exactly the data a future w8a8 step needs,
+and recording them at calibration time costs two forward passes."""
+
+from __future__ import annotations
+
+import os
+import re
+
+import numpy as np
+
+from milnce_tpu.quant.quantize import (QUANT_SCHEME, QuantizedModel,
+                                       _path_key,
+                                       per_channel_keys_from_weights,
+                                       quantize_variables)
+
+# NUMERICS.md readiness-table row: | `params/...` | ... | <verdict> |
+_VERDICT_ROW = re.compile(r"^\|\s*`(params/[^`]+)`\s*\|.*\|\s*"
+                          r"(\*{0,2}per-channel\*{0,2}|per-tensor ok)"
+                          r"\s*\|\s*$")
+
+
+def read_numerics_verdicts(report_path: str) -> dict[str, bool]:
+    """Parse the committed NUMERICS.md quantization-readiness table ->
+    {'params/<layer>': needs_per_channel}.  Empty dict when the file
+    has no readiness section (pre-Pass-5 tree) — callers fall back to
+    computing verdicts from the weights directly."""
+    verdicts: dict[str, bool] = {}
+    with open(report_path) as fh:
+        for line in fh:
+            m = _VERDICT_ROW.match(line.strip())
+            if m:
+                verdicts[m.group(1)] = "per-channel" in m.group(2)
+    return verdicts
+
+
+def collect_activation_ranges(model, variables, *, video_batches=(),
+                              text_batches=()) -> dict[str, float]:
+    """Per-submodule activation absmax over the calibration batches ->
+    {'<tower>/<module path>': absmax}.  Ranges max-reduce across
+    batches (calibration wants the envelope, not the mean)."""
+    import jax
+
+    ranges: dict[str, float] = {}
+
+    def _absorb(tower: str, intermediates) -> None:
+        flat, _ = jax.tree_util.tree_flatten_with_path(intermediates)
+        for path, leaf in flat:
+            key = f"{tower}/{_path_key(path)}"
+            absmax = float(np.abs(np.asarray(leaf)).max())
+            ranges[key] = max(ranges.get(key, 0.0), absmax)
+
+    for video in video_batches:
+        _, aux = model.apply(variables, np.asarray(video, np.float32),
+                             None, mode="video",
+                             capture_intermediates=True,
+                             mutable=["intermediates"])
+        _absorb("video", aux["intermediates"])
+    for tokens in text_batches:
+        _, aux = model.apply(variables, None,
+                             np.asarray(tokens, np.int32), mode="text",
+                             capture_intermediates=True,
+                             mutable=["intermediates"])
+        _absorb("text", aux["intermediates"])
+    return ranges
+
+
+def _rank_agreement(ref: np.ndarray, test: np.ndarray, k: int) -> float:
+    """Mean top-k overlap between two (Q, N) similarity matrices —
+    the retrieval-facing half of the quality report (cosine alone can
+    look fine while rankings reshuffle)."""
+    k = min(k, ref.shape[1])
+    ref_top = np.argsort(-ref, axis=1)[:, :k]
+    test_top = np.argsort(-test, axis=1)[:, :k]
+    hits = [len(set(r) & set(t)) / k
+            for r, t in zip(ref_top, test_top)]
+    return float(np.mean(hits))
+
+
+def quantization_quality(model, variables, qvariables, *,
+                         video_batches=(), text_batches=(),
+                         k: int = 10) -> dict:
+    """Embedding-space damage report: per-row cosine between f32 and
+    int8 embeddings for each tower, plus text->video top-k rank
+    agreement when both modalities were supplied."""
+    qmodel = QuantizedModel(model)
+
+    def _cos(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        num = (a * b).sum(axis=-1)
+        den = (np.linalg.norm(a, axis=-1)
+               * np.linalg.norm(b, axis=-1) + 1e-12)
+        return num / den
+
+    out: dict = {"scheme": QUANT_SCHEME}
+    ref_v = ref_t = q_v = q_t = None
+    if video_batches:
+        video = np.concatenate([np.asarray(b, np.float32)
+                                for b in video_batches])
+        ref_v = np.asarray(model.apply(variables, video, None,
+                                       mode="video"))
+        q_v = np.asarray(qmodel.apply(qvariables, video, None,
+                                      mode="video"))
+        cos = _cos(ref_v, q_v)
+        out["video_cosine_mean"] = float(cos.mean())
+        out["video_cosine_min"] = float(cos.min())
+    if text_batches:
+        tokens = np.concatenate([np.asarray(b, np.int32)
+                                 for b in text_batches])
+        ref_t = np.asarray(model.apply(variables, None, tokens,
+                                       mode="text"))
+        q_t = np.asarray(qmodel.apply(qvariables, None, tokens,
+                                      mode="text"))
+        cos = _cos(ref_t, q_t)
+        out["text_cosine_mean"] = float(cos.mean())
+        out["text_cosine_min"] = float(cos.min())
+    if ref_v is not None and ref_t is not None:
+        out[f"rank_agreement_top{k}"] = _rank_agreement(
+            ref_t @ ref_v.T, q_t @ q_v.T, k)
+    return out
+
+
+def calibrate_and_quantize(model, variables, *, video_batches=(),
+                           text_batches=(), per_channel_keys=None,
+                           numerics_report: str = "",
+                           k: int = 10) -> tuple[dict, dict]:
+    """The full offline pass -> (quantized variables tree, JSON-safe
+    calibration metadata block for the quantized export).
+
+    ``per_channel_keys=None`` (the default) reads the committed
+    NUMERICS.md verdicts when ``numerics_report`` names one, else
+    derives them from the weights with the same rule."""
+    if per_channel_keys is None:
+        verdicts = (read_numerics_verdicts(numerics_report)
+                    if numerics_report and os.path.exists(numerics_report)
+                    else {})
+        if verdicts:
+            # intersect with what this model can actually quantize: a
+            # committed report may cover another preset's layers (or a
+            # stale table may still carry non-quantizable 1-D rows),
+            # and quantize_variables is LOUD about unknown keys by
+            # design — the report is a default, not a command
+            import jax
+
+            from milnce_tpu.quant.quantize import _should_quantize
+
+            flat, _ = jax.tree_util.tree_flatten_with_path(
+                variables["params"])
+            quantizable = {
+                "params/" + _path_key(path) for path, leaf in flat
+                if _should_quantize(leaf)}
+            per_channel_keys = tuple(sorted(
+                key for key, pc in verdicts.items()
+                if pc and key in quantizable))
+            verdict_source = numerics_report
+        else:
+            per_channel_keys = per_channel_keys_from_weights(
+                variables["params"])
+            verdict_source = "weights (readiness rule, no report)"
+    else:
+        per_channel_keys = tuple(sorted(per_channel_keys))
+        verdict_source = "caller"
+
+    qvariables = quantize_variables(variables,
+                                    per_channel_keys=per_channel_keys)
+    calibration = {
+        "scheme": QUANT_SCHEME,
+        "per_channel": list(per_channel_keys),
+        "verdict_source": verdict_source,
+        "n_video_batches": len(video_batches),
+        "n_text_batches": len(text_batches),
+    }
+    if video_batches or text_batches:
+        ranges = collect_activation_ranges(
+            model, variables, video_batches=video_batches,
+            text_batches=text_batches)
+        # the envelope summary ships in metadata; the full per-module
+        # dict is large and only the extremes steer a future w8a8 pass
+        calibration["activation_absmax_max"] = (
+            max(ranges.values()) if ranges else 0.0)
+        calibration["activation_ranges"] = {
+            key: round(val, 6) for key, val in sorted(
+                ranges.items(), key=lambda kv: -kv[1])[:16]}
+        calibration["quality"] = quantization_quality(
+            model, variables, qvariables,
+            video_batches=video_batches, text_batches=text_batches,
+            k=k)
+    return qvariables, calibration

@@ -1,0 +1,133 @@
+"""Distilled student text tower for the edge tier.
+
+The text path is an embedding lookup plus a two-layer MLP
+(models/text.py), so distillation is cheap: keep the teacher's frozen
+word table (the lookup is under ``stop_gradient`` in teacher AND
+student — reference s3dg.py:199-200), shrink the fat 2048-d hidden
+layer, and regress the student's sentence embeddings onto frozen
+teacher embeddings over synthetic caption batches.  The student stays
+in the teacher's embedding SPACE (same ``embd_dim``), so the shared
+video tower, the retrieval index and every serving surface work
+unchanged — a student export is just an ordinary ``milnce-export``
+artifact with a thinner ``text_hidden_dim`` in its model metadata.
+
+No new training machinery: optax Adam + ``jax.value_and_grad`` on a
+jitted step, deterministic ``np.random.default_rng(seed)`` batches —
+the same recipe train/state.py uses, minus the mesh (the student is
+tiny; distillation is a host-side offline pass like quantization)."""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import numpy as np
+
+from milnce_tpu.models.text import SentenceEmbedding
+
+
+def student_model_config(teacher_cfg, hidden_dim: int):
+    """Teacher ModelConfig -> student ModelConfig: only the text hidden
+    width changes, so ``build_model`` on the export metadata
+    reconstructs the student's shapes exactly."""
+    return dataclasses.replace(teacher_cfg, text_hidden_dim=hidden_dim)
+
+
+def build_student_variables(teacher_variables, student_params) -> dict:
+    """Graft trained student text params into the full-model tree: the
+    video tower and batch_stats are the teacher's, ``text_module`` is
+    the student's — the tree a student export ships."""
+    params = dict(teacher_variables["params"])
+    params["text_module"] = student_params
+    return {"params": params,
+            "batch_stats": teacher_variables["batch_stats"]}
+
+
+def _sample_tokens(rng: np.random.Generator, batch: int, max_words: int,
+                   vocab_size: int) -> np.ndarray:
+    """Synthetic caption batch: uniform token ids with variable length,
+    pad id 0 on the tail (the contract models/text.py documents — pad
+    rows participate in the word-axis max, so the student must see
+    them at train time too)."""
+    ids = rng.integers(1, vocab_size, size=(batch, max_words),
+                       dtype=np.int64)
+    lengths = rng.integers(1, max_words + 1, size=batch)
+    ids[np.arange(max_words)[None, :] >= lengths[:, None]] = 0
+    return ids.astype(np.int32)
+
+
+def distill_text_student(model, variables, *, max_words: int,
+                         hidden_dim: int | None = None,
+                         steps: int = 200, batch_size: int = 32,
+                         learning_rate: float = 1e-2,
+                         seed: int = 0) -> tuple[dict, dict]:
+    """Distill -> (student ``text_module`` params, info dict).
+
+    ``model``/``variables`` are the full f32 teacher.  The student
+    copies the teacher's (frozen) word table and ``embd_dim``;
+    ``hidden_dim`` defaults to a quarter of the teacher's hidden
+    width.  Deterministic under fixed ``seed``."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from milnce_tpu.models.text import word2vec_embedding_init
+
+    teacher_text = variables["params"]["text_module"]
+    word_table = np.asarray(teacher_text["word_embd"]["embedding"])
+    vocab_size, word_dim = word_table.shape
+    embd_dim = int(np.asarray(teacher_text["fc2"]["kernel"]).shape[-1])
+    teacher_hidden = int(
+        np.asarray(teacher_text["fc1"]["kernel"]).shape[-1])
+    if hidden_dim is None:
+        hidden_dim = max(8, teacher_hidden // 4)
+
+    student = SentenceEmbedding(
+        embd_dim=embd_dim, vocab_size=vocab_size,
+        word_embedding_dim=word_dim, hidden_dim=hidden_dim,
+        embedding_init=word2vec_embedding_init(word_table))
+
+    rng = np.random.default_rng(seed)
+    init_ids = np.zeros((1, max_words), np.int32)
+    params = student.init(jax.random.PRNGKey(seed), init_ids)["params"]
+    opt = optax.adam(learning_rate)
+    opt_state = opt.init(params)
+
+    teacher_fn = jax.jit(
+        lambda ids: model.apply(variables, None, ids, mode="text"))
+
+    def loss_fn(p, ids, target):
+        pred = student.apply({"params": p}, ids)
+        mse = jnp.mean((pred - target) ** 2)
+        cos = jnp.sum(pred * target, axis=-1) / (
+            jnp.linalg.norm(pred, axis=-1)
+            * jnp.linalg.norm(target, axis=-1) + 1e-12)
+        return mse + (1.0 - jnp.mean(cos)), jnp.mean(cos)
+
+    # params + opt state are consumed each step — donate both so the
+    # distill loop never holds two copies of the student (GL003)
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(p, o, ids, target):
+        (loss, cos), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(p, ids, target)
+        updates, o = opt.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, loss, cos
+
+    loss = cos = float("nan")
+    for _ in range(steps):
+        ids = _sample_tokens(rng, batch_size, max_words, vocab_size)
+        target = teacher_fn(ids)
+        params, opt_state, loss, cos = train_step(params, opt_state,
+                                                  ids, target)
+    info = {
+        "hidden_dim": int(hidden_dim),
+        "teacher_hidden_dim": teacher_hidden,
+        "word_embedding_dim": int(word_dim),
+        "embd_dim": embd_dim,
+        "steps": int(steps),
+        "batch_size": int(batch_size),
+        "seed": int(seed),
+        "final_loss": float(loss),
+        "final_cosine": float(cos),
+    }
+    return jax.device_get(params), info

@@ -1,0 +1,220 @@
+"""Weight-only symmetric int8 quantization for the embed towers.
+
+The scheme is deliberately the simplest one that serves: every float
+param with ndim >= 2 (conv/dense kernels and the word-embedding table)
+is stored as int8 with a float32 scale — scalar for per-tensor, one
+per output channel (the LAST axis, matching the NUMERICS.md readiness
+table) when the readiness rule says a single scale would waste bits.
+Biases, BatchNorm affine params and all ``batch_stats`` stay f32: they
+are a rounding error of the artifact size and the f32-residency set
+(analysis/numerics.py GL015) must hold regardless of the weight store.
+
+At serve time :class:`QuantizedModel` dequantizes INSIDE the jitted
+embed program (``q.astype(f32) * scale`` then the ordinary f32
+``dot_general``): int8 weights are what lives in HBM, accumulation is
+f32 by construction — no GL016 low-precision-accumulation site exists
+anywhere on the path, which the ``serve_quant_*`` trace-invariant and
+numerics census entries pin.
+
+The readiness rule (PER_CHANNEL_RATIO / OUTLIER_FRACTION /
+last-axis-channel) lives HERE as the single source;
+``scripts/precision_audit.py`` imports it, so the committed NUMERICS.md
+verdicts and the calibration defaults can never drift apart.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+QUANT_SCHEME = "symmetric-int8"
+
+# Readiness thresholds (shared with scripts/precision_audit.py, which
+# renders them into NUMERICS.md).  A layer whose per-output-channel
+# absmax spread exceeds the ratio needs per-channel scales (one
+# per-tensor scale wastes log2(ratio) of int8's 8 bits on the quiet
+# channels); a layer with heavy >6-sigma outliers wants per-channel
+# treatment for the same reason — the outlier sets the scale.
+PER_CHANNEL_RATIO = 4.0
+OUTLIER_FRACTION = 1e-3
+
+_QMAX = 127.0     # symmetric: int8 range [-127, 127], zero-point 0
+
+
+def weight_readiness_row(key: str, arr: np.ndarray) -> dict:
+    """One quantization-readiness row for a weight array: dynamic
+    range, >6-sigma outlier ratio, per-channel absmax spread and the
+    per-channel verdict.  Pure host numpy — the single source for both
+    the NUMERICS.md table and the calibration defaults."""
+    arr = np.asarray(arr)
+    absmax = float(np.abs(arr).max()) if arr.size else 0.0
+    std = float(arr.std()) if arr.size else 0.0
+    outliers = (float((np.abs(arr) > 6 * std).mean())
+                if std > 0 else 0.0)
+    if arr.ndim >= 2:
+        ch = np.abs(arr.reshape(-1, arr.shape[-1])).max(axis=0)
+        med = float(np.median(ch))
+        ratio = float(ch.max() / med) if med > 0 else float("inf")
+    else:
+        ratio = 1.0
+    return dict(
+        key=key, shape=list(arr.shape), absmax=absmax, std=std,
+        outlier_ratio=outliers, channel_range_ratio=ratio,
+        per_channel=(ratio > PER_CHANNEL_RATIO
+                     or outliers > OUTLIER_FRACTION))
+
+
+def quantize_array(arr: np.ndarray,
+                   per_channel: bool = False) -> tuple[np.ndarray,
+                                                       np.ndarray]:
+    """f32 array -> (int8 array, f32 scale).  Per-channel scales are
+    one per LAST-axis slice (the output channel of every kernel layout
+    in this model), shape (C,), broadcastable against the weight."""
+    arr = np.asarray(arr, dtype=np.float32)
+    if per_channel:
+        if arr.ndim < 2:
+            raise ValueError("per-channel quantization needs ndim >= 2, "
+                             f"got shape {arr.shape}")
+        absmax = np.abs(arr.reshape(-1, arr.shape[-1])).max(axis=0)
+    else:
+        absmax = np.abs(arr).max()
+    scale = np.asarray(absmax, np.float32) / _QMAX
+    # all-zero tensors/channels: scale 1 keeps the round-trip exact
+    # (0 * 1 = 0) instead of dividing by zero
+    scale = np.where(scale == 0, np.float32(1.0), scale).astype(np.float32)
+    q = np.clip(np.rint(arr / scale), -_QMAX, _QMAX).astype(np.int8)
+    return q, scale
+
+
+def dequantize_array(q, scale):
+    """Inverse of :func:`quantize_array` (host or traced — works on
+    numpy and jax arrays alike; per-channel (C,) scales broadcast over
+    the (..., C) weight)."""
+    return q.astype(np.float32) * scale if isinstance(q, np.ndarray) \
+        else _jax_dequant(q, scale)
+
+
+def _jax_dequant(q, scale):
+    import jax.numpy as jnp
+
+    return q.astype(jnp.float32) * scale
+
+
+def _path_key(path) -> str:
+    """jax key-path -> '/'-joined name (mirror of serving/export.py's
+    ``_key_name`` — duplicated locally so quant/ never imports the
+    export module it feeds)."""
+    names = []
+    for k in path:
+        for attr in ("key", "name", "idx"):
+            if hasattr(k, attr):
+                names.append(str(getattr(k, attr)))
+                break
+        else:
+            names.append(str(k))
+    return "/".join(names)
+
+
+def _should_quantize(leaf) -> bool:
+    leaf = np.asarray(leaf)
+    return (leaf.dtype.kind == "f" and leaf.ndim >= 2 and leaf.size > 0)
+
+
+def per_channel_keys_from_weights(params) -> tuple[str, ...]:
+    """Apply the readiness rule directly to a params tree -> the
+    'params/...'-keyed set that needs per-channel scales.  The offline
+    path reads the committed NUMERICS.md instead
+    (calibrate.read_numerics_verdicts); this is the fallback when no
+    report is on disk."""
+    import jax
+
+    keys = []
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    for path, leaf in flat:
+        if not _should_quantize(leaf):
+            continue
+        key = "params/" + _path_key(path)
+        if weight_readiness_row(key, np.asarray(leaf))["per_channel"]:
+            keys.append(key)
+    return tuple(sorted(keys))
+
+
+def quantize_variables(variables, *,
+                       per_channel_keys=()) -> dict:
+    """{'params', 'batch_stats'} f32 tree -> quantized variables tree
+    ``{'params': <int8 where quantized>, 'batch_stats': <f32>,
+    'quant_scales': {'params/<path>': f32 scale}}``.
+
+    ``per_channel_keys`` are 'params/...'-style keys (NUMERICS.md
+    readiness-table spelling); every other quantized leaf gets one
+    per-tensor scale."""
+    import jax
+
+    per_channel = frozenset(per_channel_keys)
+    scales: dict[str, np.ndarray] = {}
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(
+        variables["params"])
+    out = []
+    for path, leaf in flat:
+        if not _should_quantize(leaf):
+            out.append(np.asarray(leaf))
+            continue
+        key = "params/" + _path_key(path)
+        q, scale = quantize_array(np.asarray(leaf),
+                                  per_channel=key in per_channel)
+        scales[key] = scale
+        out.append(q)
+    unknown = per_channel - set(scales)
+    if unknown:
+        raise ValueError("per_channel_keys name layers that are not "
+                         f"quantizable params: {sorted(unknown)}")
+    return {
+        "params": jax.tree_util.tree_unflatten(treedef, out),
+        "batch_stats": jax.tree_util.tree_map(np.asarray,
+                                              variables["batch_stats"]),
+        "quant_scales": scales,
+    }
+
+
+def dequantize_params(params, scales):
+    """Quantized params tree + flat scales dict -> f32 params tree.
+    Traceable: inside a jitted program this lowers to int8 HBM reads +
+    one convert_element_type per quantized leaf."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in flat:
+        key = "params/" + _path_key(path)
+        scale = scales.get(key)
+        out.append(leaf if scale is None
+                   else dequantize_array(leaf, scale))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class QuantizedModel:
+    """Duck-typed model wrapper serving a quantized variables tree.
+
+    The embed programs (train/step.py ``make_text_embed_fn`` /
+    ``make_video_embed_fn``) touch exactly two attributes of a model:
+    ``apply`` and ``dtype``.  This wrapper provides both, dequantizing
+    ``variables['params']`` with ``variables['quant_scales']`` before
+    delegating to the wrapped flax module — so the serving engine,
+    bucket ladder, warmup sweep, recompile accounting and replica pool
+    all run a quantized export with zero special cases."""
+
+    def __init__(self, model):
+        self.model = model
+
+    @property
+    def dtype(self):
+        import jax.numpy as jnp
+
+        return getattr(self.model, "dtype", jnp.float32)
+
+    def apply(self, variables, *args, **kwargs):
+        variables = dict(variables)
+        scales = variables.pop("quant_scales", {})
+        variables["params"] = dequantize_params(variables["params"],
+                                                scales)
+        return self.model.apply(variables, *args, **kwargs)

@@ -110,6 +110,46 @@ def cast_floats(tree, dtype):
     return jax.tree_util.tree_map(cast, tree)
 
 
+def load_serving_model(export_dir: str, dtype: str = ""):
+    """Load any ``milnce-export``-family artifact -> ``(model,
+    variables, metadata)`` ready for an :class:`InferenceEngine`.
+
+    Format detection is metadata-driven: a quantized edge-tier
+    artifact (export.QUANT_FORMAT_VERSION) loads through
+    ``load_quantized_checkpoint`` and returns a
+    :class:`~milnce_tpu.quant.quantize.QuantizedModel` wrapper — int8
+    weights resident, dequantize inside the jitted entries, f32
+    accumulation.  ``dtype`` overrides are refused for quantized
+    artifacts (the stored precision IS the artifact's contract)."""
+    from milnce_tpu.config import ModelConfig
+    from milnce_tpu.models.build import build_model
+    from milnce_tpu.serving.export import (QUANT_FORMAT_VERSION,
+                                           load_inference_checkpoint,
+                                           load_quantized_checkpoint,
+                                           read_export_metadata)
+
+    quantized = (read_export_metadata(export_dir).get("format_version")
+                 == QUANT_FORMAT_VERSION)
+    if quantized:
+        if dtype:
+            raise ValueError(
+                "dtype override is not supported for quantized exports "
+                "— int8 weights + f32 scales are the artifact's "
+                "precision contract")
+        meta, variables = load_quantized_checkpoint(export_dir)
+    else:
+        meta, variables = load_inference_checkpoint(export_dir)
+    model_cfg = ModelConfig(**meta["model"])
+    if dtype:
+        model_cfg.dtype = dtype
+    model = build_model(model_cfg)
+    if quantized:
+        from milnce_tpu.quant.quantize import QuantizedModel
+
+        model = QuantizedModel(model)
+    return model, variables, meta
+
+
 class InferenceEngine:
     """Bucketed, pre-traced, transfer-guarded embed entries over frozen
     params.
@@ -313,16 +353,17 @@ class InferenceEngine:
 
         ``dtype`` overrides the exported compute dtype ('bfloat16' casts
         the frozen params AND builds the model at bf16 — the MXU-rate
-        deployment mode; '' keeps the exported dtype)."""
-        from milnce_tpu.config import ModelConfig
-        from milnce_tpu.models.build import build_model
-        from milnce_tpu.serving.export import load_inference_checkpoint
+        deployment mode; '' keeps the exported dtype).
 
-        meta, variables = load_inference_checkpoint(export_dir)
-        model_cfg = ModelConfig(**meta["model"])
-        if dtype:
-            model_cfg.dtype = dtype
-        model = build_model(model_cfg)
+        Format detection is metadata-driven: a quantized edge-tier
+        artifact (export.QUANT_FORMAT_VERSION) loads through
+        ``load_quantized_checkpoint`` and serves behind a
+        :class:`~milnce_tpu.quant.quantize.QuantizedModel` wrapper —
+        int8 weights resident, dequantize inside the jitted entries,
+        f32 accumulation; same ladder, same recompiles=0 contract.
+        ``dtype`` overrides are refused for quantized artifacts (the
+        stored precision IS the artifact's contract)."""
+        model, variables, meta = load_serving_model(export_dir, dtype)
         return cls(model, variables, mesh,
                    text_words=meta["tokenizer"]["max_words"],
                    video_shape=meta["video_shape"],

@@ -40,6 +40,15 @@ ARRAYS_FILE = "arrays.npz"
 METADATA_FILE = "metadata.json"
 FORMAT_VERSION = 1
 
+# Quantized edge-tier artifact (milnce_tpu/quant/): SAME two files, but
+# quantized params ship int8 with their f32 scales under the
+# 'quant_scales/' key prefix, the array_dtypes manifest records 'int8'
+# entries, and metadata carries a 'quant' block (scheme + calibration
+# summary).  A separate format version so the v1 loader rejects it
+# LOUDLY instead of serving int8 bits as weights.
+QUANT_FORMAT_VERSION = 2
+SCALES_PREFIX = "quant_scales"
+
 # Live-index corpus snapshot (serving/live_index.py): the SAME boring
 # two-file shape as the params export — one npz (the corpus under the
 # 'emb' key, the exact array ``--serve.corpus_npz`` accepts) plus one
@@ -149,25 +158,13 @@ def _unflatten(arrays: dict[str, np.ndarray], prefix: str) -> dict:
     return root
 
 
-def export_inference_checkpoint(out_dir: str, params, batch_stats,
-                                model_cfg, *, max_words: int,
-                                video_shape, step: int = 0,
-                                source: str = "") -> str:
-    """Write the frozen artifact; returns ``out_dir``.
-
-    ``model_cfg`` is a ``milnce_tpu.config.ModelConfig``; host-specific
-    fields (word2vec/token-dict paths, impl-map file paths) are
-    sanitized so the artifact is self-contained."""
+def _artifact_metadata(model_cfg, *, max_words: int, video_shape,
+                       step: int, source: str, arrays: dict,
+                       format_version: int) -> dict:
+    """Shared metadata assembly for the f32 and quantized formats:
+    sanitized model config, tokenizer contract, video shape and the
+    per-array dtype manifest."""
     from milnce_tpu.config import parse_conv_impl_map
-
-    os.makedirs(out_dir, exist_ok=True)
-    arrays = _flatten(params, "params")
-    arrays.update(_flatten(batch_stats, "batch_stats"))
-    # float leaves stored f32 (bf16 is a load-time cast; f64 never ships)
-    arrays = {k: (v.astype(np.float32)
-                  if np.issubdtype(v.dtype, np.floating) else v)
-              for k, v in arrays.items()}
-    np.savez(os.path.join(out_dir, ARRAYS_FILE), **arrays)
 
     model_meta = dataclasses.asdict(model_cfg)
     model_meta["word2vec_path"] = ""        # table already lives in params
@@ -175,8 +172,8 @@ def export_inference_checkpoint(out_dir: str, params, batch_stats,
     model_meta["conv_impl_map"] = ",".join(  # resolve file specs inline
         f"{s}={i}" for s, i in sorted(impl_map.items()))
     token_dict = model_meta.pop("token_dict_path", "")
-    meta = {
-        "format_version": FORMAT_VERSION,
+    return {
+        "format_version": int(format_version),
         "generator": "milnce-export (milnce_tpu/serving/export.py)",
         "step": int(step),
         "source_checkpoint": source,
@@ -189,12 +186,110 @@ def export_inference_checkpoint(out_dir: str, params, batch_stats,
         # per-array dtype manifest: the on-disk precision contract a
         # loader (and scripts/precision_audit.py's quant-readiness
         # report) can audit without opening the npz — float leaves are
-        # f32 by construction above, everything else ships as stored
+        # f32 (or int8, in the quantized format) by construction,
+        # everything else ships as stored
         "array_dtypes": {k: str(v.dtype) for k, v in arrays.items()},
+    }
+
+
+def export_inference_checkpoint(out_dir: str, params, batch_stats,
+                                model_cfg, *, max_words: int,
+                                video_shape, step: int = 0,
+                                source: str = "") -> str:
+    """Write the frozen artifact; returns ``out_dir``.
+
+    ``model_cfg`` is a ``milnce_tpu.config.ModelConfig``; host-specific
+    fields (word2vec/token-dict paths, impl-map file paths) are
+    sanitized so the artifact is self-contained."""
+    os.makedirs(out_dir, exist_ok=True)
+    arrays = _flatten(params, "params")
+    arrays.update(_flatten(batch_stats, "batch_stats"))
+    # float leaves stored f32 (bf16 is a load-time cast; f64 never ships)
+    arrays = {k: (v.astype(np.float32)
+                  if np.issubdtype(v.dtype, np.floating) else v)
+              for k, v in arrays.items()}
+    np.savez(os.path.join(out_dir, ARRAYS_FILE), **arrays)
+    meta = _artifact_metadata(model_cfg, max_words=max_words,
+                              video_shape=video_shape, step=step,
+                              source=source, arrays=arrays,
+                              format_version=FORMAT_VERSION)
+    with open(os.path.join(out_dir, METADATA_FILE), "w") as fh:
+        json.dump(meta, fh, indent=2, sort_keys=True)
+    return out_dir
+
+
+def export_quantized_checkpoint(out_dir: str, qvariables, model_cfg, *,
+                                max_words: int, video_shape,
+                                step: int = 0, source: str = "",
+                                calibration: dict | None = None) -> str:
+    """Write a quantized edge-tier artifact; returns ``out_dir``.
+
+    ``qvariables`` is ``quant.quantize_variables`` output:
+    ``{'params': <int8 where quantized>, 'batch_stats': <f32>,
+    'quant_scales': {'params/<path>': f32 scale}}``.  int8 leaves ship
+    bit-exact (pinned by the round-trip test); float leaves coerce to
+    f32 exactly like the v1 format.  ``calibration`` is the JSON-safe
+    block ``quant.calibrate.calibrate_and_quantize`` returns."""
+    os.makedirs(out_dir, exist_ok=True)
+    arrays = _flatten(qvariables["params"], "params")
+    arrays.update(_flatten(qvariables["batch_stats"], "batch_stats"))
+    arrays = {k: (v if v.dtype == np.int8 else
+                  (v.astype(np.float32)
+                   if np.issubdtype(v.dtype, np.floating) else v))
+              for k, v in arrays.items()}
+    scales = qvariables.get("quant_scales", {})
+    for key, scale in scales.items():
+        arrays[f"{SCALES_PREFIX}/{key}"] = np.asarray(scale, np.float32)
+    np.savez(os.path.join(out_dir, ARRAYS_FILE), **arrays)
+    meta = _artifact_metadata(model_cfg, max_words=max_words,
+                              video_shape=video_shape, step=step,
+                              source=source, arrays=arrays,
+                              format_version=QUANT_FORMAT_VERSION)
+    meta["quant"] = {
+        "scheme": "symmetric-int8",
+        "n_quantized": len(scales),
+        "per_channel": sorted(
+            k for k, s in scales.items() if np.asarray(s).ndim),
+        "calibration": calibration or {},
     }
     with open(os.path.join(out_dir, METADATA_FILE), "w") as fh:
         json.dump(meta, fh, indent=2, sort_keys=True)
     return out_dir
+
+
+def read_export_metadata(export_dir: str) -> dict:
+    """Metadata alone (no arrays): how a loader decides which format
+    family an artifact is before touching the npz."""
+    with open(os.path.join(export_dir, METADATA_FILE)) as fh:
+        return json.load(fh)
+
+
+def load_quantized_checkpoint(export_dir: str) -> tuple[dict, dict]:
+    """Read a quantized export -> (metadata, ``{'params',
+    'batch_stats', 'quant_scales'}`` variables tree).  Every array is
+    checked against the on-disk ``array_dtypes`` manifest — the
+    bit-exactness contract is only as good as the dtype it round-trips
+    at."""
+    meta = read_export_metadata(export_dir)
+    if meta.get("format_version") != QUANT_FORMAT_VERSION:
+        raise ValueError(
+            f"quantized export format {meta.get('format_version')!r} "
+            f"unsupported (this build reads {QUANT_FORMAT_VERSION})")
+    meta["model"].pop("token_dict_path", None)
+    with np.load(os.path.join(export_dir, ARRAYS_FILE)) as z:
+        arrays = {k: z[k] for k in z.files}
+    manifest = meta.get("array_dtypes", {})
+    for key, value in arrays.items():
+        want = manifest.get(key)
+        if want is not None and str(value.dtype) != want:
+            raise ValueError(f"array {key!r} is {value.dtype}, manifest "
+                             f"says {want} — corrupt or rewritten npz")
+    prefix = SCALES_PREFIX + "/"
+    scales = {k[len(prefix):]: v for k, v in arrays.items()
+              if k.startswith(prefix)}
+    return meta, {"params": _unflatten(arrays, "params"),
+                  "batch_stats": _unflatten(arrays, "batch_stats"),
+                  "quant_scales": scales}
 
 
 def load_inference_checkpoint(export_dir: str) -> tuple[dict, dict]:
@@ -203,8 +298,13 @@ def load_inference_checkpoint(export_dir: str) -> tuple[dict, dict]:
     with open(os.path.join(export_dir, METADATA_FILE)) as fh:
         meta = json.load(fh)
     if meta.get("format_version") != FORMAT_VERSION:
+        hint = (" — a quantized artifact; load with "
+                "load_quantized_checkpoint"
+                if meta.get("format_version") == QUANT_FORMAT_VERSION
+                else "")
         raise ValueError(f"export format {meta.get('format_version')!r} "
-                         f"unsupported (this build reads {FORMAT_VERSION})")
+                         f"unsupported (this build reads {FORMAT_VERSION}"
+                         f"){hint}")
     # ModelConfig round-trips through JSON minus the serve-sanitized field
     meta["model"].pop("token_dict_path", None)
     with np.load(os.path.join(export_dir, ARRAYS_FILE)) as z:

@@ -84,6 +84,27 @@ def shard_rung(size: int, n_data: int, k: int, floor: int = 0) -> int:
     return rung
 
 
+def recommended_min_shard_rows(corpus_rows: int, n_data: int,
+                               headroom: int = 2) -> int:
+    """``--serve.index_min_shard_rows`` sizing rule for a corpus that is
+    expected to GROW to ~``corpus_rows``: the rung that fits
+    ``headroom`` x the per-device share, so ingest reaches the target
+    size (and then some) without ever crossing a rung — zero index
+    recompiles over the corpus's whole planned life.
+
+    HowTo100M scale: ~1.2M videos over an 8-way data axis with the
+    default 2x headroom lands on 524288 (= 2**19) rows/shard — 4M rows
+    of pre-provisioned capacity, ~2 GiB/device of f32 corpus at
+    D=512."""
+    if corpus_rows <= 0:
+        raise ValueError("corpus_rows must be positive")
+    if n_data <= 0:
+        raise ValueError("n_data must be positive")
+    if headroom < 1:
+        raise ValueError("headroom must be >= 1")
+    return shard_rung(int(corpus_rows) * int(headroom), n_data, 1)
+
+
 class _Generation:
     """One immutable published corpus generation.  Everything here is
     written once by the builder (or ``__init__``) before publication and

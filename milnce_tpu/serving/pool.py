@@ -93,6 +93,14 @@ DEGRADED = "DEGRADED"
 QUARANTINED = "QUARANTINED"
 STATE_NUM = {SERVING: 0, DEGRADED: 1, QUARANTINED: 2}
 
+# Replica classes (heterogeneous pools, ROADMAP item 5): the default
+# full-precision tier and the edge tier (int8-quantized or distilled-
+# student engines built from a quantized/student export).  Class names
+# are plain strings — these two are the conventions the service and
+# serve_bench speak.
+F32_CLASS = "f32"
+EDGE_CLASS = "edge"
+
 # Worker idle poll (bounds close() latency) and the hedge monitor's
 # minimum resolution; latency samples kept for the hedge quantile.
 _IDLE_POLL_S = 0.05
@@ -127,11 +135,13 @@ class _Dispatch:
     are guarded by the pool's ``_state_lock``."""
 
     __slots__ = ("entry", "rows", "future", "t0", "attempts", "hedged",
-                 "primary_rid")
+                 "primary_rid", "cls")
 
-    def __init__(self, entry: str, rows: np.ndarray):
+    def __init__(self, entry: str, rows: np.ndarray,
+                 cls: Optional[str] = None):
         self.entry = entry
         self.rows = rows
+        self.cls = cls              # replica-class pin (None = any)
         self.future: Future = Future()
         self.t0 = time.monotonic()
         # attempts/hedged/primary_rid are only touched under the owning
@@ -148,9 +158,11 @@ class Replica:
     itself holds no lock — state transitions and routing must see one
     consistent snapshot across all replicas)."""
 
-    def __init__(self, rid: int, engine, queue_depth: int):
+    def __init__(self, rid: int, engine, queue_depth: int,
+                 cls: str = F32_CLASS):
         self.rid = rid
         self.engine = engine
+        self.cls = cls              # replica class, immutable after build
         self.queue: queue.Queue[_Dispatch] = queue.Queue(maxsize=queue_depth)
         # ---- everything below: guarded-by the pool's _state_lock ----
         self.state = SERVING
@@ -182,13 +194,24 @@ class ReplicaPool:
                  error_threshold: int = 3, slo_ms: float = 0.0,
                  slo_breaches: int = 5, probe_interval_s: float = 1.0,
                  hedge_quantile: float = 0.0, hedge_min_ms: float = 20.0,
-                 max_requeues: int = 1,
+                 max_requeues: int = 1, classes: Optional[Sequence] = None,
                  registry: Optional[obs_metrics.MetricsRegistry] = None,
                  recorder: Optional[obs_spans.SpanRecorder] = None,
                  on_latency: Optional[Callable[[float, int], None]] = None):
         engines = list(engines)
         if not engines:
             raise ValueError("a replica pool needs at least one engine")
+        # Heterogeneous pools: ``classes`` labels each engine with its
+        # replica class ('f32' full-precision, 'edge' int8/student, or
+        # any caller-defined string).  Routing, requeue and hedging all
+        # stay WITHIN a dispatch's requested class; the ladder must
+        # still be uniform across classes — a class switch must never
+        # change which bucket a batch pads to.
+        classes = ([F32_CLASS] * len(engines) if classes is None
+                   else [str(c) for c in classes])
+        if len(classes) != len(engines):
+            raise ValueError(f"{len(classes)} classes for "
+                             f"{len(engines)} engines")
         ladders = {tuple(e.buckets) for e in engines}
         if len(ladders) != 1:
             raise ValueError(f"replica bucket ladders diverge: {ladders} — "
@@ -203,8 +226,9 @@ class ReplicaPool:
         self.hedge_quantile = float(hedge_quantile)
         self.hedge_min_ms = float(hedge_min_ms)
         self.max_requeues = int(max_requeues)
-        self.replicas = [Replica(i, e, queue_depth)
-                         for i, e in enumerate(engines)]
+        self.replicas = [Replica(i, e, queue_depth, cls=c)
+                         for i, (e, c) in enumerate(zip(engines, classes))]
+        self.classes = tuple(classes)
         self._state_lock = make_lock("serving.pool.state")
         self._latencies: deque = deque(maxlen=_LATENCY_WINDOW)  # guarded-by: _state_lock
         self._inflight: set = set()                             # guarded-by: _state_lock
@@ -287,17 +311,21 @@ class ReplicaPool:
     def bucket_for(self, n: int) -> int:
         return self.replicas[0].engine.bucket_for(n)
 
-    def embed_text(self, token_ids: np.ndarray) -> np.ndarray:
-        return self.submit_text(token_ids).result()
+    def embed_text(self, token_ids: np.ndarray,
+                   cls: Optional[str] = None) -> np.ndarray:
+        return self.submit_text(token_ids, cls=cls).result()
 
-    def embed_video(self, video_u8: np.ndarray) -> np.ndarray:
-        return self.submit_video(video_u8).result()
+    def embed_video(self, video_u8: np.ndarray,
+                    cls: Optional[str] = None) -> np.ndarray:
+        return self.submit_video(video_u8, cls=cls).result()
 
-    def submit_text(self, token_ids: np.ndarray) -> Future:
-        return self._submit("text", token_ids)
+    def submit_text(self, token_ids: np.ndarray,
+                    cls: Optional[str] = None) -> Future:
+        return self._submit("text", token_ids, cls=cls)
 
-    def submit_video(self, video_u8: np.ndarray) -> Future:
-        return self._submit("video", video_u8)
+    def submit_video(self, video_u8: np.ndarray,
+                     cls: Optional[str] = None) -> Future:
+        return self._submit("video", video_u8, cls=cls)
 
     def recompiles(self) -> int:
         """Jit-cache growth since warmup summed over SURVIVING (non-dead)
@@ -315,21 +343,29 @@ class ReplicaPool:
         for r in self.replicas:
             for key, n in r.engine.stats().get("calls", {}).items():
                 calls[key] = calls.get(key, 0) + n
+        counts: dict[str, int] = {}
+        for c in self.classes:
+            counts[c] = counts.get(c, 0) + 1
         return {
             "buckets": list(self.buckets),
             "max_batch": self.max_batch,
             "recompiles": self.recompiles(),
             "replicas": len(self.replicas),
+            "classes": dict(sorted(counts.items())),
             "calls": dict(sorted(calls.items())),
         }
 
     # ---- submission / routing --------------------------------------------
 
-    def _submit(self, entry: str, rows: np.ndarray) -> Future:
+    def _submit(self, entry: str, rows: np.ndarray,
+                cls: Optional[str] = None) -> Future:
         if self._closed.is_set():
             raise RuntimeError("replica pool is closed")
-        d = _Dispatch(entry, np.asarray(rows))
-        targets = self._route()
+        if cls is not None and cls not in self.classes:
+            raise ValueError(f"no {cls!r} replica class in this pool "
+                             f"(classes: {sorted(set(self.classes))})")
+        d = _Dispatch(entry, np.asarray(rows), cls=cls)
+        targets = self._route(cls=cls)
         rid = self._enqueue(d, targets, primary=True)
         if rid < 0:
             self._m_saturated.inc()
@@ -347,14 +383,21 @@ class ReplicaPool:
                 self._drain_closed(r)
         return d.future
 
-    def _route(self, exclude: tuple = ()) -> list:
+    def _route(self, exclude: tuple = (),
+               cls: Optional[str] = None) -> list:
         """Routable replicas, best-first: SERVING by least outstanding,
-        then DEGRADED by least outstanding.  Raises PoolUnavailable when
-        nothing is routable."""
+        then DEGRADED by least outstanding.  ``cls`` restricts routing
+        to one replica class — STRICT: a class-pinned dispatch with no
+        routable replica of that class fails PoolUnavailable even if
+        another class has capacity (a caller asking for the edge tier
+        asked for its precision/latency contract, not any answer).
+        Raises PoolUnavailable when nothing is routable."""
         with self._state_lock:
-            serving = [r for r in self.replicas
+            pool = [r for r in self.replicas
+                    if cls is None or r.cls == cls]
+            serving = [r for r in pool
                        if r.state == SERVING and r.rid not in exclude]
-            degraded = [r for r in self.replicas
+            degraded = [r for r in pool
                         if r.state == DEGRADED and r.rid not in exclude]
             # least-outstanding first; equal depths rotate round-robin
             # (a fixed tie-break would starve every replica but one at
@@ -366,9 +409,11 @@ class ReplicaPool:
             serving.sort(key=key)
             degraded.sort(key=key)
         if not serving and not degraded:
+            scope = (f"class {cls!r} ({len(pool)} replicas)"
+                     if cls is not None else f"pool of {len(self.replicas)}")
             raise PoolUnavailable(
                 "no SERVING or DEGRADED replica left "
-                f"(pool of {len(self.replicas)}, exclude={list(exclude)})")
+                f"({scope}, exclude={list(exclude)})")
         return serving + degraded
 
     def _enqueue(self, d: _Dispatch, targets: list,
@@ -476,7 +521,7 @@ class ReplicaPool:
             attempts = d.attempts
         if attempts <= self.max_requeues:
             try:
-                targets = self._route(exclude=(replica.rid,))
+                targets = self._route(exclude=(replica.rid,), cls=d.cls)
             except PoolUnavailable as unavailable:
                 # nobody left to retry on: the caller-facing error is
                 # the DEGRADATION signal (the service's cache-only /
@@ -621,7 +666,7 @@ class ReplicaPool:
 
     def _hedge(self, d: _Dispatch) -> None:
         try:
-            targets = self._route(exclude=(d.primary_rid,))
+            targets = self._route(exclude=(d.primary_rid,), cls=d.cls)
         except PoolUnavailable:
             return                      # nobody to hedge onto
         if self._enqueue(d, targets) >= 0:
@@ -688,6 +733,7 @@ class ReplicaPool:
         with self._state_lock:
             reps = [{
                 "id": r.rid,
+                "class": r.cls,
                 "state": r.state,
                 "outstanding": r.outstanding,
                 "consecutive_errors": r.consecutive_errors,
@@ -774,22 +820,53 @@ class ReplicaPool:
                     dtype: str = "", max_batch: int = 64,
                     min_bucket: int = 0, data_axis: str = "data",
                     devices=None, precompile: bool = True,
+                    edge_export_dir: str = "", edge_replicas: int = 0,
+                    edge_class: str = EDGE_CLASS,
                     **pool_kwargs) -> "ReplicaPool":
         """Pooled twin of ``InferenceEngine.from_export``: one frozen
-        export served by ``n_replicas`` engines."""
-        from milnce_tpu.config import ModelConfig
-        from milnce_tpu.models.build import build_model
-        from milnce_tpu.serving.export import load_inference_checkpoint
+        export served by ``n_replicas`` engines.
 
-        meta, variables = load_inference_checkpoint(export_dir)
-        model_cfg = ModelConfig(**meta["model"])
-        if dtype:
-            model_cfg.dtype = dtype
-        model = build_model(model_cfg)
-        return cls.build(model, variables, n_replicas,
-                         text_words=meta["tokenizer"]["max_words"],
-                         video_shape=meta["video_shape"],
-                         max_batch=max_batch, min_bucket=min_bucket,
-                         data_axis=data_axis,
-                         cast_dtype=(dtype or None), precompile=precompile,
-                         **pool_kwargs)
+        ``edge_export_dir``/``edge_replicas`` add a heterogeneous edge
+        tier: that many extra replicas built from a SECOND artifact
+        (int8-quantized or distilled-student export — any format the
+        engine's loader detects), registered under ``edge_class``.
+        Both artifacts must agree on the serving contract (tokenizer
+        max_words, video shape — same embedding space is the exporter's
+        responsibility); every replica serves the same bucket ladder,
+        so a class switch never changes batch padding."""
+        import jax
+        from jax.sharding import Mesh
+
+        from milnce_tpu.serving.engine import load_serving_model
+
+        model, variables, meta = load_serving_model(export_dir, dtype)
+        specs = [(model, variables, (dtype or None), F32_CLASS)
+                 ] * n_replicas
+        if edge_export_dir and edge_replicas:
+            emodel, evars, emeta = load_serving_model(edge_export_dir)
+            if (emeta["tokenizer"]["max_words"]
+                    != meta["tokenizer"]["max_words"]
+                    or list(emeta["video_shape"])
+                    != list(meta["video_shape"])):
+                raise ValueError(
+                    "edge export disagrees with the f32 export on the "
+                    "serving contract: max_words "
+                    f"{emeta['tokenizer']['max_words']} vs "
+                    f"{meta['tokenizer']['max_words']}, video_shape "
+                    f"{emeta['video_shape']} vs {meta['video_shape']}")
+            specs += [(emodel, evars, None, edge_class)] * edge_replicas
+        devs = list(devices if devices is not None else jax.devices())
+        groups = cls.partition_devices(devs, len(specs))
+        floor = max(min_bucket, max(len(g) for g in groups))
+        engines, classes = [], []
+        for i, (group, (m, v, cast, rep_cls)) in enumerate(
+                zip(groups, specs)):
+            mesh = Mesh(np.asarray(group), (data_axis,))
+            engines.append(InferenceEngine(
+                m, v, mesh, text_words=meta["tokenizer"]["max_words"],
+                video_shape=meta["video_shape"], max_batch=max_batch,
+                min_bucket=floor, data_axis=data_axis, cast_dtype=cast,
+                precompile=precompile,
+                dispatch_lock=make_lock(f"serving.replica{i}.dispatch")))
+            classes.append(rep_cls)
+        return cls(engines, classes=classes, **pool_kwargs)

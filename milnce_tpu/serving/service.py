@@ -16,7 +16,10 @@ batcher anyway — handler threads just block on futures.
 Endpoints (JSON in/out):
 
 - ``POST /v1/query``       {"token_ids": [[...]] | "sentences": [...],
-                            "k": int?, "timeout_ms": float?, "tier": str?}
+                            "k": int?, "timeout_ms": float?, "tier": str?,
+                            "replica_class": str?}  ("f32"/"edge" pins the
+                           request to one pool replica class — SERVING.md
+                           "Edge tier"; omitted = any class)
                            -> {"results": [{"indices": [...],
                                             "scores": [...]}, ...],
                                "index_generation": int?}  (live index
@@ -463,7 +466,8 @@ class RetrievalService:
 
     def embed_text_ids(self, token_ids: np.ndarray,
                        timeout_ms: Optional[float] = None,
-                       tier: Optional[str] = None) -> np.ndarray:
+                       tier: Optional[str] = None,
+                       replica_class: Optional[str] = None) -> np.ndarray:
         """(n, W) int32 -> (n, D): cache hits answered on host, misses
         batched through the engine; results land back in the cache.
 
@@ -472,10 +476,21 @@ class RetrievalService:
         :class:`DegradedError` — the degradation ladder's cache-only
         tier (an all-hit request still succeeds because it never reaches
         the batcher).  ``tier`` names the request's SLO class when the
-        controller has tiers configured (None = highest priority)."""
+        controller has tiers configured (None = highest priority).
+
+        ``replica_class`` pins the request to one pool replica class
+        ('f32' / 'edge' — SERVING.md "Edge tier").  Class-pinned
+        requests bypass the batcher AND the embedding cache: the
+        batcher's queue is class-blind, and cached rows carry no class
+        stamp — an edge-tier int8 embedding silently answering a later
+        full-precision request would mix tiers.  None (the default)
+        batches across every class as usual."""
         rows = np.ascontiguousarray(token_ids, dtype=np.int32)
         if rows.ndim != 2:
             raise ValueError(f"expected (n, W) token ids, got {rows.shape}")
+        if replica_class is not None and self._pool is None:
+            raise ValueError("replica_class requires a pooled service "
+                             "(--serve.replicas > 1 or an edge tier)")
         # admission judges the EFFECTIVE deadline (the batcher applies
         # default_timeout_ms to a None request deadline, so feasibility
         # must see the same number — a raw None would silently disable
@@ -483,6 +498,8 @@ class RetrievalService:
         eff_timeout_ms = (self._default_timeout_ms if timeout_ms is None
                           else float(timeout_ms))
         with self._admission.admit(rows.shape[0], eff_timeout_ms, tier):
+            if replica_class is not None:
+                return self._embed_class_pinned(rows, replica_class)
             keys = [token_key(r) for r in rows]
             out: list[Optional[np.ndarray]] = [self.cache.get(k)
                                                for k in keys]
@@ -505,6 +522,24 @@ class RetrievalService:
             return np.stack(out) if out else np.zeros(
                 (0, self.engine.embed_dim or 0), np.float32)
 
+    def _embed_class_pinned(self, rows: np.ndarray,
+                            replica_class: str) -> np.ndarray:
+        """Direct class-pinned dispatch (no batcher, no cache): the pool
+        pads each chunk to its bucket; chunks stay within max_batch."""
+        top = self.engine.max_batch
+        if rows.shape[0] == 0:
+            return np.zeros((0, self.engine.embed_dim or 0), np.float32)
+        try:
+            return np.concatenate(
+                [self._pool.embed_text(rows[lo:lo + top],
+                                       cls=replica_class)
+                 for lo in range(0, rows.shape[0], top)])
+        except PoolUnavailable as exc:
+            self._m_degraded.labels(reason=exc.reason).inc()
+            raise DegradedError(
+                f"no healthy {replica_class!r} replica to embed this "
+                f"request ({exc})", exc.reason) from exc
+
     def _result_wait_s(self, timeout_ms: Optional[float]) -> Optional[float]:
         t_ms = (self._default_timeout_ms if timeout_ms is None
                 else float(timeout_ms))
@@ -522,7 +557,8 @@ class RetrievalService:
     def query_ids_with_gen(self, token_ids: np.ndarray,
                            k: Optional[int] = None,
                            timeout_ms: Optional[float] = None,
-                           tier: Optional[str] = None
+                           tier: Optional[str] = None,
+                           replica_class: Optional[str] = None
                            ) -> tuple[np.ndarray, np.ndarray,
                                       Optional[int]]:
         """(n, W) token ids -> ((n, k) scores, (n, k) corpus indices,
@@ -537,7 +573,8 @@ class RetrievalService:
             raise ValueError(f"k={k} outside [1, index k={self.index.k}]")
         self._m_queries.inc(len(token_ids))
         try:
-            emb = self.embed_text_ids(token_ids, timeout_ms, tier)
+            emb = self.embed_text_ids(token_ids, timeout_ms, tier,
+                                      replica_class)
             if hasattr(self.index, "topk_with_gen"):
                 scores, idx, gen = self.index.topk_with_gen(emb)
             else:
@@ -552,24 +589,28 @@ class RetrievalService:
 
     def query_ids(self, token_ids: np.ndarray, k: Optional[int] = None,
                   timeout_ms: Optional[float] = None,
-                  tier: Optional[str] = None
+                  tier: Optional[str] = None,
+                  replica_class: Optional[str] = None
                   ) -> tuple[np.ndarray, np.ndarray]:
         """(n, W) token ids -> ((n, k) scores, (n, k) corpus indices)."""
         scores, idx, _ = self.query_ids_with_gen(token_ids, k, timeout_ms,
-                                                 tier)
+                                                 tier, replica_class)
         return scores, idx
 
     def query_sentences_with_gen(self, sentences, k: Optional[int] = None,
                                  timeout_ms: Optional[float] = None,
-                                 tier: Optional[str] = None):
+                                 tier: Optional[str] = None,
+                                 replica_class: Optional[str] = None):
         return self.query_ids_with_gen(self._encode(sentences), k,
-                                       timeout_ms, tier)
+                                       timeout_ms, tier, replica_class)
 
     def query_sentences(self, sentences, k: Optional[int] = None,
                         timeout_ms: Optional[float] = None,
-                        tier: Optional[str] = None
+                        tier: Optional[str] = None,
+                        replica_class: Optional[str] = None
                         ) -> tuple[np.ndarray, np.ndarray]:
-        return self.query_ids(self._encode(sentences), k, timeout_ms, tier)
+        return self.query_ids(self._encode(sentences), k, timeout_ms, tier,
+                              replica_class)
 
     # ---- write path (live index ingest) ----------------------------------
 
@@ -741,7 +782,8 @@ class _Handler(BaseHTTPRequestHandler):
             elif self.path == "/v1/embed_text":
                 rows = self._token_rows(req)
                 emb = self.service.embed_text_ids(
-                    rows, req.get("timeout_ms"), req.get("tier"))
+                    rows, req.get("timeout_ms"), req.get("tier"),
+                    req.get("replica_class"))
                 self._reply(200, {"embeddings": emb.tolist()})
             elif self.path == "/v1/index/add":
                 out = self.service.index_add(
@@ -785,11 +827,12 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _dispatch(self, by_ids, by_sentences, req: dict):
         k, t, tier = req.get("k"), req.get("timeout_ms"), req.get("tier")
+        cls = req.get("replica_class")
         if "token_ids" in req:
             return by_ids(np.asarray(req["token_ids"], np.int32), k, t,
-                          tier)
+                          tier, cls)
         if "sentences" in req:
-            return by_sentences(req["sentences"], k, t, tier)
+            return by_sentences(req["sentences"], k, t, tier, cls)
         raise ValueError("request needs 'token_ids' or 'sentences'")
 
 
@@ -831,7 +874,12 @@ def main(argv=None) -> None:
                          "artifact directory)")
     initialize_distributed(cfg.parallel)
     mesh = build_mesh(cfg.parallel)
-    if s.replicas > 1:
+    edge = bool(s.edge_export_dir) and s.edge_replicas > 0
+    if s.edge_replicas > 0 and not s.edge_export_dir:
+        raise SystemExit("--serve.edge_replicas needs "
+                         "--serve.edge_export_dir (the quantized/student "
+                         "artifact the edge class serves)")
+    if s.replicas > 1 or edge:
         from milnce_tpu.serving.pool import ReplicaPool
 
         engine = ReplicaPool.from_export(
@@ -843,7 +891,10 @@ def main(argv=None) -> None:
             slo_breaches=s.slo_breaches,
             probe_interval_s=s.probe_interval_s,
             hedge_quantile=s.hedge_quantile, hedge_min_ms=s.hedge_min_ms,
-            max_requeues=s.max_requeues, registry=obs_metrics.registry())
+            max_requeues=s.max_requeues,
+            edge_export_dir=s.edge_export_dir,
+            edge_replicas=s.edge_replicas,
+            registry=obs_metrics.registry())
     else:
         engine = InferenceEngine.from_export(
             s.export_dir, mesh, dtype=s.dtype, max_batch=s.max_batch,
@@ -943,7 +994,8 @@ def main(argv=None) -> None:
     # flush: operators poll a redirected log for this readiness line
     print(f"milnce-serve: listening on http://{s.host}:"
           f"{server.server_address[1]} (buckets {engine.buckets}, "
-          f"replicas={s.replicas}, "
+          f"replicas={s.replicas}"
+          + (f"+{s.edge_replicas} edge" if edge else "") + ", "
           f"index={'none' if index is None else index.size}, "
           f"tokenizer={'yes' if tokenizer else 'token_ids-only'}; "
           f"Prometheus scrape: /metrics)",

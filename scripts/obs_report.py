@@ -77,6 +77,14 @@ GATE_DIRECTIONS = {
     # are counted separately and do NOT gate here); chaos benches pin
     # error-rate drift with this
     "error_rate": "lower",
+    # edge tier (ISSUE 19): retrieval quality of a serve_bench
+    # ``--tier-class`` record, measured as top-10 overlap against the
+    # f32 class's rankings on the same query pool.  Gating an edge-class
+    # (int8 / distilled-student) record against the committed f32
+    # baseline pins the quality floor; the dtype_census_hash note below
+    # marks the compare as cross-precision so latency drift stays
+    # attributable to the precision change
+    "recall_at_10": "higher",
 }
 
 
@@ -182,7 +190,7 @@ def gate_metrics(artifact: dict) -> dict[str, float]:
             out[dst] = float(v)
     for key in ("qps", "clips_per_sec_per_chip",
                 "predicted_peak_bytes_per_chip", "mfu",
-                "goodput_fraction", "error_rate"):
+                "goodput_fraction", "error_rate", "recall_at_10"):
         v = doc.get(key)
         if isinstance(v, (int, float)):
             out[key] = float(v)

@@ -75,18 +75,30 @@ HEADER = ("<!-- (auto-written by scripts/precision_audit.py — do not "
 # forces.
 WHAT_IF_POINT = dict(batch=256, frames=32, size=224)
 
-# Quantization-readiness thresholds (host-side numpy over arrays.npz).
-# A layer whose per-output-channel absmax spread exceeds the ratio needs
-# per-channel scales (one per-tensor scale wastes
-# log2(ratio) of int8's 8 bits on the quiet channels); a layer with
-# heavy >6-sigma outliers wants clipping/percentile calibration.
-PER_CHANNEL_RATIO = 4.0
-OUTLIER_FRACTION = 1e-3
+# Quantization-readiness rule: single-sourced from the quantizer
+# (milnce_tpu/quant/quantize.py), so the committed NUMERICS.md verdicts
+# and the calibration defaults that READ them back
+# (quant/calibrate.py read_numerics_verdicts) can never drift apart.
+# quantize.py is numpy-only at import time, so this import is safe
+# before _force_devices/jax.
+from milnce_tpu.quant.quantize import (OUTLIER_FRACTION,  # noqa: E402
+                                       PER_CHANNEL_RATIO,
+                                       weight_readiness_row)
+
+# Deterministic short-train recipe for the committed readiness table:
+# the verdicts must come from TRAINED weights (an init-table verdict
+# says nothing about the ranges training grows — ISSUE 19), and regen
+# must reproduce it bit-for-bit without a checkpoint lying around.
+_TRAIN_STEPS = 25
 
 
 def quant_readiness(npz_path: str) -> list:
     """Per-layer weight statistics for int8 planning: dynamic range,
-    outlier ratio, per-channel spread — pure host numpy, no jax."""
+    outlier ratio, per-channel spread — pure host numpy, no jax.  One
+    row per QUANTIZABLE float param (ndim >= 2 — the quantizer's own
+    eligibility rule; biases/BN vectors stay f32 and never get a
+    verdict, so the table is exactly the set `milnce-quantize` reads
+    back as calibration defaults)."""
     import numpy as np
 
     rows = []
@@ -95,38 +107,35 @@ def quant_readiness(npz_path: str) -> list:
             if not key.startswith("params/"):
                 continue
             arr = np.asarray(z[key])
-            if arr.dtype.kind != "f" or arr.size == 0:
+            if arr.dtype.kind != "f" or arr.size == 0 or arr.ndim < 2:
                 continue
-            absmax = float(np.abs(arr).max())
-            std = float(arr.std())
-            outliers = (float((np.abs(arr) > 6 * std).mean())
-                        if std > 0 else 0.0)
-            if arr.ndim >= 2:
-                ch = np.abs(arr.reshape(-1, arr.shape[-1])).max(axis=0)
-                med = float(np.median(ch))
-                ratio = float(ch.max() / med) if med > 0 else float("inf")
-            else:
-                ratio = 1.0
-            rows.append(dict(
-                key=key, shape=list(arr.shape), absmax=absmax, std=std,
-                outlier_ratio=outliers, channel_range_ratio=ratio,
-                per_channel=(ratio > PER_CHANNEL_RATIO
-                             or outliers > OUTLIER_FRACTION)))
+            rows.append(weight_readiness_row(key, arr))
     return rows
 
 
 def _tiny_export(out_dir: str) -> str:
-    """Deterministic tiny export (PRNGKey(0) init — the same state the
-    analysis entries trace) for the committed quant-readiness table, so
-    regen never depends on a checkpoint lying around."""
+    """Deterministic short-TRAIN export for the committed
+    quant-readiness table: the analysis entries' PRNGKey(0) state
+    driven ``_TRAIN_STEPS`` MIL-NCE steps over fixed-seed synthetic
+    batches (the trace-invariant ``batch(seed)`` generator), then
+    exported.  Trained ranges are what the int8 verdicts are FOR —
+    init-time ranges are an accident of the initializer — and the
+    fixed seeds keep regen reproducible with no checkpoint dependency."""
+    import jax
+
     from milnce_tpu.analysis.trace_invariants import (_FRAMES, _SIZE,
                                                       _TINY, _WORDS,
                                                       _setup)
     from milnce_tpu.config import ModelConfig
     from milnce_tpu.serving.export import (ARRAYS_FILE,
                                            export_inference_checkpoint)
+    from milnce_tpu.train.step import make_train_step
 
-    _model, _opt, _mesh, state, _batch = _setup()
+    model, opt, mesh, state, batch = _setup()
+    step = make_train_step(model, opt, mesh, donate=False)
+    for seed in range(_TRAIN_STEPS):
+        state, _metrics = step(state, *batch(seed))
+    state = jax.device_get(state)
     mcfg = ModelConfig(embedding_dim=_TINY["embedding_dim"],
                        vocab_size=_TINY["vocab_size"],
                        word_embedding_dim=_TINY["word_embedding_dim"],
@@ -135,11 +144,13 @@ def _tiny_export(out_dir: str) -> str:
     export_inference_checkpoint(
         out_dir, state.params, state.batch_stats, mcfg,
         max_words=_WORDS, video_shape=(_FRAMES, _SIZE, _SIZE, 3),
-        source="precision_audit deterministic tiny init")
+        step=_TRAIN_STEPS,
+        source=f"precision_audit deterministic {_TRAIN_STEPS}-step train "
+               "(PRNGKey(0) init, fixed-seed synthetic batches)")
     return os.path.join(out_dir, ARRAYS_FILE)
 
 
-_CENSUS_COLS = ("f32", "bf16", "f16", "i32", "u8", "bool")
+_CENSUS_COLS = ("f32", "bf16", "f16", "i8", "i32", "u8", "bool")
 
 
 def _census_cells(census: dict) -> list:
@@ -256,18 +267,23 @@ def _render_report(audits: dict, results, what_ifs=None,
                 lines.append(f"- {n}x `{site}`")
             lines.append("")
     if quant_rows is not None:
-        lines.append("## Quantization readiness (ROADMAP item 5 feed)")
+        lines.append("## Quantization readiness (the int8 edge tier's "
+                     "calibration defaults)")
         lines.append("")
         n_pc = sum(r["per_channel"] for r in quant_rows)
         lines.append(
             f"Host-side numpy over `{quant_src}`: per-layer weight "
             "dynamic range, >6-sigma outlier ratio and per-output-"
-            "channel absmax spread.  Verdict `per-channel` = the "
-            f"channel range ratio exceeds {PER_CHANNEL_RATIO:g}x (or "
-            f"outliers exceed {OUTLIER_FRACTION:g}) — one per-tensor "
-            "int8 scale would waste log2(ratio) of the 8 bits on quiet "
-            f"channels.  {n_pc}/{len(quant_rows)} layers need "
-            "per-channel scales.")
+            "channel absmax spread, via the quantizer's own readiness "
+            "rule (`milnce_tpu/quant/quantize.py` — single source).  "
+            "Verdict `per-channel` = the channel range ratio exceeds "
+            f"{PER_CHANNEL_RATIO:g}x (or outliers exceed "
+            f"{OUTLIER_FRACTION:g}) — one per-tensor int8 scale would "
+            "waste log2(ratio) of the 8 bits on quiet channels.  "
+            f"{n_pc}/{len(quant_rows)} layers need per-channel scales.  "
+            "`milnce-quantize` (quant/calibrate.py) reads these "
+            "verdicts back from this table as its per-channel defaults "
+            "— SERVING.md \"Edge tier\".")
         lines.append("")
         lines.append("| layer | shape | absmax | std | outliers>6σ "
                      "| channel ratio | int8 verdict |")
@@ -402,8 +418,10 @@ def main(argv=None) -> int:
             else:
                 tmp = tempfile.mkdtemp(prefix="precision_audit_export_")
                 npz = _tiny_export(tmp)
-                quant_src = ("deterministic tiny export (PRNGKey(0) "
-                             "init, milnce-export format)")
+                quant_src = (f"deterministic tiny TRAINED export "
+                             f"(PRNGKey(0) init + {_TRAIN_STEPS} "
+                             "fixed-seed MIL-NCE steps, milnce-export "
+                             "format)")
             quant_rows = quant_readiness(npz)
         with open(args.report, "w") as fh:
             fh.write(_render_report(audits, results, what_ifs=what_ifs,

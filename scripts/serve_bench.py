@@ -26,6 +26,15 @@ Modes:
   sweeps the offered load (doubling per round) and reports each tier's
   QPS knee — the last load the service cleared inside
   ``--knee_slo_ms``.
+- **tier-class** (``--tier-class``): bench each serving replica class
+  (f32 / int8 / distilled student — SERVING.md "Edge tier")
+  sequentially at the SAME offered load, one
+  ``SERVE_BENCH_<preset>_class_<class>.json`` record per class.  Each
+  record carries ``recall_at_10`` (top-10 overlap against the f32
+  class's rankings on a fixed query pool; an ``obs_report --check``
+  gate metric) and the program's ``dtype_census_hash``, so gating an
+  edge class against the committed f32 baseline pins the quality floor
+  while latency drift stays attributable to the precision change.
 
 Live-index options: ``--live_index`` serves through the
 generation-swapped ``LiveRetrievalIndex`` and ``--ingest_rows N
@@ -58,11 +67,18 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
 
-def build_service(args):
+def build_service(args, tier_class=""):
     """Tiny-preset service stack: random frozen params (or an export),
     synthetic video corpus, programmatic API only.  ``--replicas N``
     builds a ReplicaPool (N single-device engines on the CPU backend)
-    instead of one engine — the chaos-bench configuration."""
+    instead of one engine — the chaos-bench configuration.
+
+    ``tier_class`` swaps the random-init tower for its edge-tier
+    counterpart before the engine is built: ``"int8"`` quantizes the
+    frozen tree (weight-only symmetric int8, per-channel where the
+    readiness rule demands — quant/quantize.py) and serves it through
+    ``QuantizedModel``; ``"student"`` distils the text tower
+    (quant/distill.py) and serves the grafted student variables."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -107,6 +123,27 @@ def build_service(args):
             jnp.zeros((1, cfg.data.max_words), jnp.int32))
         frozen = {"params": variables["params"],
                   "batch_stats": variables.get("batch_stats", {})}
+        if tier_class == "int8":
+            from milnce_tpu.quant.quantize import (
+                QuantizedModel, per_channel_keys_from_weights,
+                quantize_variables)
+
+            frozen = quantize_variables(
+                frozen, per_channel_keys=per_channel_keys_from_weights(
+                    frozen["params"]))
+            model = QuantizedModel(model)
+        elif tier_class == "student":
+            from milnce_tpu.quant.distill import (
+                build_student_variables, distill_text_student,
+                student_model_config)
+
+            sparams, sinfo = distill_text_student(
+                model, frozen, max_words=cfg.data.max_words)
+            model = build_model(student_model_config(cfg.model,
+                                                     sinfo["hidden_dim"]))
+            frozen = build_student_variables(frozen, sparams)
+        elif tier_class:
+            raise ValueError(f"unknown tier class {tier_class!r}")
         if args.replicas > 1:
             from milnce_tpu.serving.pool import ReplicaPool
 
@@ -310,6 +347,155 @@ def parse_tier_qps(spec: str) -> dict:
     return out
 
 
+# serving replica classes the --tier-class comparison knows how to
+# build (SERVING.md "Edge tier"); f32 is the recall baseline
+TIER_CLASSES = ("f32", "int8", "student")
+
+
+def _tier_class_rankings(service, cfg, k: int):
+    """Top-``k`` corpus ids for a FIXED deterministic query pool — the
+    cross-class recall probe.  Same seed for every class, so overlap
+    against the f32 class's rankings is attributable to the tower swap
+    alone, not query drift."""
+    import numpy as np
+
+    rng = np.random.default_rng(17)
+    pool = rng.integers(1, cfg.model.vocab_size,
+                        (16, cfg.data.max_words)).astype(np.int32)
+    top = service.engine.buckets[-1]
+    idx = []
+    for lo in range(0, len(pool), top):
+        _scores, ids = service.query_ids(pool[lo:lo + top])
+        idx.append(np.asarray(ids))
+    return np.concatenate(idx, axis=0)[:, :k]
+
+
+def recall_at_k(idx, base_idx) -> float:
+    """Mean top-k overlap fraction against the baseline rankings."""
+    k = idx.shape[1]
+    return float(sum(len(set(a) & set(b)) for a, b in zip(idx, base_idx))
+                 / (len(idx) * k))
+
+
+def _dtype_census_hash(service, cfg) -> str:
+    """Precision fingerprint of the service's text embed program at the
+    bottom bucket (analysis/numerics.py) — stamped into each class
+    record so ``obs_report --check`` marks cross-class gates as
+    cross-precision compares instead of plain regressions."""
+    import numpy as np
+
+    from milnce_tpu.analysis import numerics
+
+    engine = service.engine
+    tokens = np.zeros((engine.buckets[0], cfg.data.max_words), np.int32)
+    # the engine's device-resident tree IS the program's weight operand
+    audit = numerics.audit_fn(engine.jit_entries()["text"],
+                              (engine._variables, tokens),
+                              argnames=("variables", "tokens"),
+                              entry="serve_bench_text")
+    return audit.census_hash()
+
+
+def run_tier_class(args) -> int:
+    """``--tier-class``: bench every class in ``--classes``
+    sequentially at the SAME offered load, one milnce.obs/v1 record per
+    class.  The f32 class runs first and its top-10 rankings are the
+    recall baseline; the exit gate requires recompiles == 0 for every
+    class — an edge class that re-traces under the f32 bucket ladder is
+    a fail, not a footnote."""
+    classes = [c.strip() for c in args.classes.split(",") if c.strip()]
+    bad = sorted(set(classes) - set(TIER_CLASSES))
+    if bad:
+        raise SystemExit(f"serve_bench: unknown --classes {bad}; "
+                         f"known classes: {', '.join(TIER_CLASSES)}")
+    if not classes or classes[0] != "f32":
+        raise SystemExit("serve_bench: --tier-class needs f32 FIRST in "
+                         "--classes — it is the recall@10 baseline")
+    k = min(10, args.corpus)
+    args.topk = max(args.topk, k)   # the index must answer top-10
+    base_idx = None
+    outputs = []
+    ok = True
+    for cls in classes:
+        t0 = time.monotonic()
+        cfg, service = build_service(
+            args, tier_class="" if cls == "f32" else cls)
+        warmup_s = time.monotonic() - t0
+        idx = _tier_class_rankings(service, cfg, k)
+        if base_idx is None:
+            base_idx = idx
+        recall = recall_at_k(idx, base_idx)
+        census = _dtype_census_hash(service, cfg)
+        draw = make_query_draw(cfg, args.distinct)
+        t_run = time.monotonic()
+        if args.mode == "closed":
+            lats, counters = run_closed_loop(
+                service, draw, args.duration, args.concurrency)
+        else:
+            lats, counters = run_open_loop(
+                service, draw, args.duration, args.qps)
+        elapsed = time.monotonic() - t_run
+        errors = counters["errors"]
+        expired = counters["deadline_expired"]
+        health = service.health()
+        service.close()
+        if args.replicas > 1:
+            service.engine.close()
+        extra = {
+            "generator": "scripts/serve_bench.py",
+            "mode": f"tier-class/{args.mode}",
+            "backend": args.backend,
+            "preset": args.preset,
+            "tier_class": cls,
+            "config": {key: v for key, v in vars(args).items()
+                       if key != "out"},
+            "warmup_s": round(warmup_s, 3),
+            "elapsed_s": round(elapsed, 3),
+            "requests": len(lats),
+            "errors": errors,
+            "deadline_expired": expired,
+            "resilience": {key: counters[key]
+                           for key in ("shed", "degraded")},
+            "error_rate": round(
+                errors / max(1, len(lats) + errors + expired
+                             + counters["shed"] + counters["degraded"]),
+                5),
+            "qps": round(len(lats) / elapsed, 2) if elapsed > 0 else 0.0,
+            "latency_ms": _lat_summary(lats),
+            # the edge-tier quality gate (obs_report: higher is better)
+            "recall_at_10": round(recall, 4),
+            "dtype_census_hash": census,
+            "cache": health["cache"],
+            "engine": health["engine"],
+            "index": health["index"],
+        }
+        from milnce_tpu.obs import export as obs_export
+        from milnce_tpu.obs.runctx import auto_run_id
+
+        report = obs_export.snapshot(service.registry, kind="serve_bench",
+                                     extra=extra,
+                                     run_id=auto_run_id("sbench-"),
+                                     process_index=0)
+        out = os.path.join(
+            _REPO, f"SERVE_BENCH_{args.preset}_class_{cls}.json")
+        with open(out, "w") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+        outputs.append((cls, report, out))
+        ok = ok and report["engine"]["recompiles"] in (0, -1)
+    print(f"serve_bench --tier-class: {len(outputs)} classes at the "
+          f"same offered load (mode={args.mode}, "
+          f"duration={args.duration}s)")
+    for cls, report, out in outputs:
+        print(f"  class {cls:<8} qps={report['qps']:<8g} "
+              f"p50={report['latency_ms']['p50']}ms "
+              f"p99={report['latency_ms']['p99']}ms "
+              f"recall@10={report['recall_at_10']} "
+              f"census={report['dtype_census_hash']} "
+              f"recompiles={report['engine']['recompiles']} "
+              f"-> {os.path.basename(out)}")
+    return 0 if ok else 1
+
+
 def knee_from_rounds(rounds: list, slo_ms: float,
                      min_served_frac: float = 0.9):
     """The QPS knee from an open-loop sweep: the highest offered load
@@ -459,6 +645,17 @@ def main(argv=None) -> int:
                     help="admission tier spec 'name:share[,...]' "
                          "(service.parse_tier_spec grammar); '' with "
                          "--tiers = first tier 1.0, the rest 0.5")
+    ap.add_argument("--tier-class", dest="tier_class",
+                    action="store_true",
+                    help="per-replica-class comparison: bench every "
+                         "class in --classes sequentially at the same "
+                         "offered load, one SERVE_BENCH_<preset>_class_"
+                         "<class>.json record per class with recall@10 "
+                         "vs the f32 rankings + the program's "
+                         "dtype_census_hash (SERVING.md 'Edge tier')")
+    ap.add_argument("--classes", default="f32,int8,student",
+                    help="--tier-class roster (f32 must come first: it "
+                         "is the recall@10 baseline)")
     ap.add_argument("--knee", action="store_true",
                     help="with --tiers: sweep offered load (doubling per "
                          "round) and report each tier's QPS knee")
@@ -492,6 +689,11 @@ def main(argv=None) -> int:
 
     if args.ingest_rows and not args.live_index:
         ap.error("--ingest_rows needs --live_index")
+    if args.tier_class:
+        if args.tiers or args.export_dir or args.live_index or args.faults:
+            ap.error("--tier-class is a self-contained comparison: drop "
+                     "--tiers/--export_dir/--live_index/--faults")
+        return run_tier_class(args)
     tier_qps = parse_tier_qps(args.tiers) if args.tiers else None
     if tier_qps and not args.tier_shares:
         # default shares: the first (highest-priority) tier may use the

@@ -392,6 +392,26 @@ class TestRungRule:
         assert shard_rung(65, 8, 5) == 16      # 9 rows/shard -> rung 16
         assert shard_rung(12, 8, 5, floor=32) == 32
 
+    def test_recommended_min_shard_rows_sizing_rule(self):
+        """The ``--serve.index_min_shard_rows`` sizing helper (ISSUE 19
+        small fix): plan the rung for the corpus's end-of-life size so
+        growth to ``headroom`` x never re-traces the query program."""
+        from milnce_tpu.serving.live_index import (
+            recommended_min_shard_rows, shard_rung)
+
+        # HowTo100M scale: ~1.2M videos, 8-way data axis, 2x headroom
+        # -> 2**19 rows/shard (the documented config.py default)
+        assert recommended_min_shard_rows(1_200_000, 8) == 524_288
+        # and the rung is exactly what the ladder would pick at the
+        # doubled corpus size, so the first swap lands in-rung
+        assert shard_rung(2_400_000, 8, 1,
+                          floor=recommended_min_shard_rows(
+                              1_200_000, 8)) == 524_288
+        assert recommended_min_shard_rows(100, 8, headroom=1) == 16
+        for bad in ((0, 8, 2), (100, 0, 2), (100, 8, 0)):
+            with pytest.raises(ValueError):
+                recommended_min_shard_rows(*bad)
+
 
 # ---------------------------------------------------------------------------
 # ISSUE 14 satellite: the 16-thread ingest-while-query hammer under the

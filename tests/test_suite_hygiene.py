@@ -388,6 +388,30 @@ def test_curriculum_gates_exist_and_stay_tier1():
             f"{fname}::{slow}")
 
 
+# edge-tier gates (ISSUE 19): the quantized-export bit-exact
+# round-trip, the recall@10 degradation budgets (int8 + distilled
+# student vs f32), strict class-pinned pool routing and the NUMERICS.md
+# verdict parser are the regression fence for the edge serving tier.
+# Same rule as every other subsystem gate: tier-1, never @slow, never
+# vanished.
+_QUANT_GATES = ("test_quant.py",)
+
+
+def test_quant_gates_exist_and_stay_tier1():
+    for fname in _QUANT_GATES:
+        path = os.path.join(_TESTS, fname)
+        assert os.path.exists(path), f"edge-tier gate {fname} is missing"
+        src = open(path).read()
+        tests = list(_iter_tests(ast.parse(src)))
+        assert tests, f"{fname} defines no tests"
+        slow = [node.name for node, class_slow in tests
+                if _is_slow_marked(node, class_slow)]
+        assert not slow, (
+            "edge-tier tests must be tier-1/CPU-safe, never @slow "
+            "(they are the quantized-serving regression fence): "
+            f"{fname}::{slow}")
+
+
 def test_fast_child_exemptions_stay_real():
     """Every _FAST_CHILD_EXEMPT entry must name a test that still
     exists — a stale exemption is a hole the audit thinks it covers."""
