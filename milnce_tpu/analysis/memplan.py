@@ -528,6 +528,17 @@ def _e_train_2d(grad_cache: bool = False):
     return build
 
 
+def _e_train_4way():
+    def build(donate: bool = False):
+        from milnce_tpu.analysis.trace_invariants import _setup_4way
+        from milnce_tpu.train.step import make_train_step
+
+        model, opt, mesh, state, batch = _setup_4way()
+        step = make_train_step(model, opt, mesh, donate=donate)
+        return step, (state,) + batch()
+    return build
+
+
 def _e_train_chunked():
     def build(donate: bool = False):
         from milnce_tpu.analysis.trace_invariants import (_chunked_loss_cfg,
@@ -781,6 +792,9 @@ def _entries() -> dict:
                  argnames=("video", "text")),
         MemEntry("milnce_loss_chunked", _e_milnce_loss("chunked"),
                  argnames=("video", "text")),
+        MemEntry("train_step_milnce@4way", _e_train_4way(),
+                 donate_argnums=DON, grad_bearing=True,
+                 mesh="4x1 (data)"),
         MemEntry("train_step_milnce_2d", _e_train_2d(),
                  donate_argnums=DON, grad_bearing=True,
                  mesh="4x2 (data,model)"),
@@ -835,6 +849,13 @@ EXPECTED_PEAK_BYTES = {
     "train_step_milnce_chunked": 10612424,
     "milnce_loss_dense": 2863940,
     "milnce_loss_chunked": 703276,
+    # elastic 4-way layout (ISSUE 20): pinned IDENTICAL to the 8-way
+    # step — per-chip peak is a function of clips PER CHIP (2 at both
+    # layouts: b = 2*ndev shards evenly), so downsizing the mesh halves
+    # the global batch, never the per-chip footprint.  That equality is
+    # the elastic memory contract: a resume onto fewer chips fits
+    # wherever the full mesh fit.
+    "train_step_milnce@4way": 10612424,
     "train_step_milnce_2d": 8652104,
     "grad_cache_2d": 11399984,
     "serve_text_embed@b0": 2119092,
@@ -877,6 +898,12 @@ EXPECTED_TOP_CONTRIBUTORS = {
         "state/opt_state/inner_state/inner_state/0/mu/conv_2c/conv_temporal/kernel",
         "state/opt_state/inner_state/inner_state/0/mu/mixed_3b/conv_b1_b/conv_spatial/kernel"),
     "train_step_milnce_guarded": (
+        "state/opt_state/inner_state/inner_state/0/mu/conv_2c/conv_spatial/kernel",
+        "state/opt_state/inner_state/inner_state/0/mu/conv_2c/conv_temporal/kernel",
+        "state/opt_state/inner_state/inner_state/0/mu/mixed_3b/conv_b1_b/conv_spatial/kernel"),
+    # 4-way elastic-resume layout: per-chip hot set identical to the
+    # 8-way entry — replicated optimizer moments dominate at both
+    "train_step_milnce@4way": (
         "state/opt_state/inner_state/inner_state/0/mu/conv_2c/conv_spatial/kernel",
         "state/opt_state/inner_state/inner_state/0/mu/conv_2c/conv_temporal/kernel",
         "state/opt_state/inner_state/inner_state/0/mu/mixed_3b/conv_b1_b/conv_spatial/kernel"),

@@ -423,6 +423,10 @@ EXPECTED_DTYPE_CENSUS = {
         "i32": 592, "f32": 64258732, "u8": 196608, "bool": 216534},
     "train_step_milnce_guarded": {
         "i32": 608, "f32": 70595824, "u8": 196608, "bool": 744209},
+    # 4-way elastic-resume layout: same program, 2 clips/chip — u8 video
+    # doubles per chip, f32 shrinks (fewer psum partials), casts as 8-way
+    "train_step_milnce@4way": {
+        "i32": 432, "f32": 64253516, "u8": 98304, "bool": 216502},
     "train_step_sdtw3": {
         "i32": 1864, "f32": 67776548, "u8": 196608, "bool": 233142},
     "grad_cache_step_milnce": {
@@ -478,6 +482,11 @@ EXPECTED_CASTS = {
         "i32->f32 @ state/opt_state/hyperparams_states/learning_rate/count": 1,
         "f32->f32 @ max": 2, "i32->i32 @ nest-boundary": 3,
         "i32->f32 @ pjit": 2, "bool->i32 @ not": 1},
+    "train_step_milnce@4way": {
+        "u8->f32 @ video": 1, "bool->f32 @ eq": 4,
+        "i32->f32 @ state/opt_state/hyperparams_states/learning_rate/count": 1,
+        "f32->f32 @ max": 2, "i32->i32 @ nest-boundary": 3,
+        "i32->f32 @ pjit": 2},
     "train_step_sdtw3": {
         "u8->f32 @ video": 1, "bool->f32 @ eq": 4,
         "i32->i32 @ nest-boundary": 15, "f32->f32 @ nest-boundary": 18,

@@ -91,6 +91,15 @@ EXPECTED_COLLECTIVES = {
                                   "reduce_scatter": 2},
     "train_step_milnce_chunked_2d": {"all_gather": 22, "psum": 78,
                                      "reduce_scatter": 22},
+    # elastic 4-way layout (ISSUE 20): the DOWNSIZED data mesh a drained
+    # run resumes onto (parallel.num_devices=4 on an 8-device host).
+    # The multiset is pinned IDENTICAL to the 8-way step by construction
+    # — collective STRUCTURE is a function of the program, not the axis
+    # size (4-way vs 8-way only changes shard extents) — and pinning it
+    # per layout is what makes a topology change's communication plan a
+    # deliberate re-pin instead of an accident.
+    "train_step_milnce@4way": {"all_gather": 2, "psum": 26,
+                               "reduce_scatter": 2},
     "train_step_sdtw3": {"all_gather": 3, "psum": 25,
                          "reduce_scatter": 2},
     "grad_cache_step_milnce": {"all_gather": 2, "psum": 26,
@@ -503,6 +512,52 @@ def _setup_2d():
         "2-D entry setup shards nothing — the pinned program would be "
         f"pure replication (threshold {_FSDP_MIN_SIZE})")
     return model, opt, mesh, placement.specs, placement.state, batch
+
+
+@functools.lru_cache(maxsize=1)
+def _setup_4way():
+    """The downsized elastic twin of :func:`_setup`: same tiny model and
+    state, 1-D data mesh over the FIRST 4 of the host's 8 virtual
+    devices — the layout ``parallel.num_devices=4`` builds for a
+    drained run resuming at half capacity (milnce_tpu/elastic/)."""
+    import jax
+    import numpy as np
+
+    from milnce_tpu.config import ParallelConfig
+    from milnce_tpu.parallel.mesh import build_mesh
+
+    model, opt, _mesh8, state, _batch8 = _setup()
+    assert len(jax.devices()) >= 8, "4-way elastic entry needs 8 devices"
+    mesh = build_mesh(ParallelConfig(), devices=jax.devices()[:4])
+    b = 2 * 4                         # 2 per shard on the smaller mesh
+
+    def batch(seed: int = 0):
+        rng = np.random.default_rng(seed)
+        video = rng.integers(0, 255, (b, _FRAMES, _SIZE, _SIZE, 3),
+                             dtype=np.uint8)
+        text = rng.integers(0, _TINY["vocab_size"], (b, _WORDS)).astype(
+            np.int32)
+        start = np.zeros((b,), np.float32)
+        return video, text, start
+
+    return model, opt, mesh, state, batch
+
+
+def _entry_train_step_4way() -> list[CheckResult]:
+    """ISSUE 20: the elastic resume layout's per-layout pins — the
+    4-way step must keep the 8-way collective multiset (a topology
+    change rescales shard extents, never communication structure) and
+    compile exactly once (the acceptance's 0-recompiles-per-topology-
+    segment, at the trace layer)."""
+    from milnce_tpu.train.step import make_train_step
+
+    model, opt, mesh, state, batch = _setup_4way()
+    step = make_train_step(model, opt, mesh, donate=False)
+    name = "train_step_milnce@4way"
+    out = _jaxpr_checks(name, step, (state,) + batch())
+    out.append(_recompile_check(name, step,
+                                lambda s: (state,) + batch(s)))
+    return out
 
 
 def _entry_train_step_2d() -> list[CheckResult]:
@@ -1027,6 +1082,7 @@ ENTRY_POINTS = {
     "train_step_curriculum": _entry_train_step_curriculum,
     "train_step_sdtw3": _entry_train_step_sdtw3,
     "grad_cache_step_milnce": _entry_grad_cache_step,
+    "train_step_milnce@4way": _entry_train_step_4way,
     "train_step_milnce_2d": _entry_train_step_2d,
     "grad_cache_2d": _entry_grad_cache_2d,
     "train_step_milnce_chunked": _entry_train_step_milnce_chunked,

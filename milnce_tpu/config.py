@@ -248,6 +248,17 @@ class ParallelConfig:
                                         # accelerator plugins override
                                         # JAX_PLATFORMS, so this applies
                                         # jax.config before backend init.
+    num_devices: int = 0                # build the mesh over the FIRST N
+                                        # local devices only (0 = all) —
+                                        # how an elastic resume boots a
+                                        # SMALLER mesh on the same host
+                                        # (8-way -> 4-way; MIGRATING.md
+                                        # "Checkpoint resharding") and how
+                                        # tests shape-change in one
+                                        # process.  Multi-host capacity
+                                        # changes use num_processes
+                                        # instead; both reshard through
+                                        # the same restore-template path.
 
 
 @dataclass
@@ -355,6 +366,31 @@ class TrainConfig:
                                         # well inside the preemption grace
                                         # window (e.g. 300ms steps + 30s grace
                                         # -> N<=50; multi-second steps -> N<=5).
+    drain_signal_file: str = ""         # drain trigger for orchestrators
+                                        # that can't deliver SIGTERM: the
+                                        # loop polls for this path once per
+                                        # step and starts a cooperative
+                                        # drain (checkpoint + ELASTIC_STAMP
+                                        # + drained exit status) when it
+                                        # appears ('' = SIGTERM/fault-site
+                                        # only; milnce_tpu/elastic/)
+    straggler_ratio: float = 1.25       # live straggler rule: a host whose
+                                        # window step-time p50 exceeds
+                                        # ratio x the fastest host's is
+                                        # flagged (same rule obs_report
+                                        # --merge applies post-hoc;
+                                        # elastic/straggler.py)
+    straggler_window: int = 3           # consecutive flagged display
+                                        # windows before the host is
+                                        # DEMOTED in the goodput ledger
+                                        # (one slow window is noise; a
+                                        # streak is a bad host)
+    straggler_resize: bool = False      # on demotion, also emit a
+                                        # straggler.resize_recommended
+                                        # event (drain + resume without
+                                        # the slow host) — recommendation
+                                        # only: training can't evict a
+                                        # host mid-collective
     curriculum: str = ""                # staged (frames, resolution, batch)
                                         # training schedule — ordered
                                         # 'num_frames=4,resolution=64,

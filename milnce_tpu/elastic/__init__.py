@@ -1,0 +1,44 @@
+"""Elastic pod training: preemption-aware drain, cross-topology resume,
+and straggler-adaptive scheduling (ROADMAP item 4, ELASTIC.md section of
+ROBUSTNESS.md).
+
+The resilience story (PR 3) survives bad *steps* within a fixed mesh;
+this package survives capacity changes of the mesh itself.  It composes
+pieces the repo already has — ``place_tree`` reshards checkpoints
+across layouts in both directions, the goodput ledger attributes
+badput, ``obs_report --merge`` names slow hosts — into three runtime
+behaviors threaded through train/loop.py:
+
+- **drain** (:mod:`milnce_tpu.elastic.drain`): a preemption signal
+  (SIGTERM, a drain signal file, or the ``host.preempt`` fault site)
+  finishes the in-flight optimizer step, forces a rotation checkpoint
+  through the existing atomic tmp+rename discipline, writes the
+  versioned ``ELASTIC_STAMP.json`` sidecar, and exits with a distinct
+  "drained" status (``DRAINED_EXIT_CODE``, EX_TEMPFAIL: rerun with
+  ``--train.resume true``).
+- **cross-topology resume** (:mod:`milnce_tpu.elastic.stamp`): the next
+  boot may use a DIFFERENT mesh shape (8-way -> 4x2 -> 4-way); the FSDP
+  sharding map is re-derived for the new layout, the checkpoint
+  reshards through the restore-template path, and the plan cursor
+  (``plan.locate``) is mesh-independent so the data stream never skips
+  or repeats a batch.  Indivisible batches and schedule-removed resumes
+  refuse loudly.
+- **straggler policy** (:mod:`milnce_tpu.elastic.straggler`): the
+  cross-host step-time skew metric ``obs_report --merge`` computes
+  feeds a live policy that emits ``straggler`` events, demotes a
+  persistently slow host in the goodput ledger, and (behind a knob)
+  recommends a drain-and-resize.
+"""
+
+from milnce_tpu.elastic.drain import DRAINED_EXIT_CODE, DrainController
+from milnce_tpu.elastic.stamp import (ELASTIC_STAMP_NAME,
+                                      check_topology_resume,
+                                      read_elastic_stamp,
+                                      write_elastic_stamp)
+from milnce_tpu.elastic.straggler import StragglerPolicy
+
+__all__ = [
+    "DRAINED_EXIT_CODE", "DrainController", "ELASTIC_STAMP_NAME",
+    "check_topology_resume", "read_elastic_stamp", "write_elastic_stamp",
+    "StragglerPolicy",
+]

@@ -23,6 +23,14 @@ a ledger that partitions the run's wall clock into:
 | ``data_wait``  | ``data.wait`` spans (device_prefetch pulls: host   |
 |                | blocked assembling/decoding the next batch)        |
 | ``checkpoint`` | ``ckpt.save`` + ``ckpt.restore`` spans             |
+| ``drain``      | ``elastic.drain`` spans: the forced stop-save of a |
+|                | preemption drain (used INSTEAD of ``ckpt.save``    |
+|                | there — the save is badput the preemption caused,  |
+|                | not routine checkpoint overhead)                   |
+| ``reshard``    | ``elastic.resume`` spans: restoring a rotation     |
+|                | onto a (possibly different) mesh layout — used     |
+|                | INSTEAD of ``ckpt.restore`` when a topology stamp  |
+|                | is present, so resize cost is attributable         |
 | ``skipped``    | step time of finite-guard-skipped updates (badput: |
 |                | the chip ran, the update was discarded), prorated  |
 |                | from the display events' ``skipped_total`` deltas  |
@@ -53,7 +61,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 CATEGORIES = ("compute", "compile", "stage_switch", "data_wait",
-              "checkpoint", "skipped", "rollback_lost", "unattributed")
+              "checkpoint", "drain", "reshard", "skipped",
+              "rollback_lost", "unattributed")
 
 # span name -> raw bucket (before the skipped/rollback reattribution)
 _SPAN_BUCKETS = {
@@ -63,6 +72,8 @@ _SPAN_BUCKETS = {
     "ckpt.save": "checkpoint",
     "ckpt.restore": "checkpoint",
     "stage.switch": "stage_switch",
+    "elastic.drain": "drain",
+    "elastic.resume": "reshard",
 }
 
 

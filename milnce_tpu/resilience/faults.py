@@ -51,6 +51,21 @@ swap leaves the old generation serving and never wedges the builder):
                        seconds (a wedged ingest caller; queries must be
                        unaffected; default x=5).
 
+Elastic sites (milnce_tpu/elastic/, threaded through the train loop —
+the occurrence count of both is the optimizer step number, because the
+loop polls/fires them exactly once per step):
+
+- ``host.preempt``     host; delivers the drain signal at step N
+                       (``host.preempt@N``) — the deterministic stand-in
+                       for a TPU-VM maintenance SIGTERM: the loop
+                       finishes the in-flight step, force-checkpoints,
+                       writes ELASTIC_STAMP.json and exits drained.
+- ``host.slow``        host; inflates THIS process's step wall time by
+                       ``x`` seconds (default x=0.05) — a persistently
+                       slow host for the straggler policy to flag and
+                       demote (on a single process it simply stretches
+                       the recorded step spans).
+
 Spec grammar (config ``train.faults`` or env ``MILNCE_FAULTS``)::
 
     spec   := clause (';' clause)*
@@ -82,7 +97,8 @@ from milnce_tpu.obs import metrics as obs_metrics
 KNOWN_SITES = ("decode.raise", "decode.hang", "ckpt.save_ioerror",
                "grad.nonfinite", "serve.dispatch_raise",
                "serve.dispatch_hang", "serve.replica_dead",
-               "index.swap_raise", "index.ingest_hang")
+               "index.swap_raise", "index.ingest_hang",
+               "host.preempt", "host.slow")
 
 # Process-wide injection telemetry (OBSERVABILITY.md): chaos drills and
 # failure-rate dashboards read how often each site actually fired.

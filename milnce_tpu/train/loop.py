@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from milnce_tpu import elastic
 from milnce_tpu.config import Config
 from milnce_tpu.data.pipeline import (ShardedLoader, device_prefetch,
                                       flatten_text, shard_placer)
@@ -137,6 +138,10 @@ class TrainResult:
                                 # non-finite gradients (0 when disabled)
     rollbacks: int = 0          # circuit-breaker checkpoint restores
     stage: int = 0              # curriculum stage at exit (flat runs: 0)
+    drained: bool = False       # exited on a preemption drain (SIGTERM /
+                                # signal file / host.preempt) with a
+                                # forced checkpoint + ELASTIC_STAMP —
+                                # the CLI maps this to DRAINED_EXIT_CODE
 
 
 def _finalize_goodput_ledger(rec, rec_path, run_id, process_index,
@@ -221,7 +226,19 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
         # armed before any decode or step build so every site sees it
         faults.arm(cfg.train.faults)
     initialize_distributed(cfg.parallel)
-    mesh = build_mesh(cfg.parallel)
+    # Elastic capacity (milnce_tpu/elastic/): parallel.num_devices builds
+    # the mesh over a PREFIX of the local devices — how a drained run
+    # resumes onto a smaller mesh on the same host (8-way -> 4-way) and
+    # how the chaos tests change topology within one process.
+    mesh_devices = None
+    if cfg.parallel.num_devices:
+        avail = jax.devices()
+        if cfg.parallel.num_devices > len(avail):
+            raise ValueError(
+                f"parallel.num_devices={cfg.parallel.num_devices} exceeds "
+                f"the {len(avail)} visible devices")
+        mesh_devices = avail[:cfg.parallel.num_devices]
+    mesh = build_mesh(cfg.parallel, devices=mesh_devices)
     axis = cfg.parallel.data_axis
     # 2-D (data, model) mesh: the batch shards over BOTH axes (every
     # chip is a data shard — global-batch semantics identical to a 1-D
@@ -308,7 +325,10 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
     # tests/test_goodput.py).  FLOPs only for configs the analytic
     # model covers (bench.py applies the identical guard: DTW losses
     # and the two-pass grad-accum step would make the number fiction).
-    n_chips = len(jax.devices())
+    n_chips = int(mesh.devices.size)    # the mesh's chips, not the
+    #                                     host's — an elastic 4-way resume
+    #                                     on an 8-device host must not
+    #                                     halve its MFU by fiction
     dev0 = jax.devices()[0]
     peak = roofline_peak(str(getattr(dev0, "device_kind", dev0.platform)))
 
@@ -476,8 +496,26 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
             flat_frames=cfg.data.num_frames,
             flat_resolution=cfg.data.video_size,
             flat_batch=cfg.train.batch_size)
-        with rec.span("ckpt.restore", label="latest"):
-            start_epoch, state = manager.restore_latest(state)
+        # Topology guard (elastic/stamp.py), also before any Orbax I/O:
+        # indivisible per-stage batches and a stale sidecar pair refuse
+        # loudly; a mesh-shape change is logged and the restore runs
+        # under the elastic.resume span so the reshard cost lands in the
+        # ledger's reshard bucket instead of hiding in checkpoint.
+        estamp = elastic.read_elastic_stamp(ckpt_dir)
+        topo_note = elastic.check_topology_resume(
+            estamp, mesh_shape=dict(mesh.shape),
+            batch_sizes=[st.batch_size for st in plan.stages],
+            curriculum_stamp=curriculum.read_stage_stamp(ckpt_dir))
+        if topo_note:
+            logger.log(topo_note)
+        if estamp is not None:
+            with rec.span("elastic.resume", label="latest",
+                          from_mesh=str(dict(estamp.get("mesh") or {})),
+                          to_mesh=str(dict(mesh.shape))):
+                start_epoch, state = manager.restore_latest(state)
+        else:
+            with rec.span("ckpt.restore", label="latest"):
+                start_epoch, state = manager.restore_latest(state)
         # Mid-epoch checkpoints (preemption / max_steps) are labeled
         # with the CURRENT epoch; the restored step counter places us
         # inside it via the plan's locate() — the containing stage
@@ -551,15 +589,21 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
     # save a checkpoint and exit cleanly instead of losing the epoch (the
     # reference has no preemption handling — SURVEY.md §5 failure-detection
     # note; recovery there is manual restart from the last epoch file).
-    preempted = {"flag": False}
+    # The controller (elastic/drain.py) latches SIGTERM, the
+    # train.drain_signal_file path, and the host.preempt fault site into
+    # one per-step poll; a drained exit forces a checkpoint + writes
+    # ELASTIC_STAMP.json and returns TrainResult(drained=True).
+    drain = elastic.DrainController(
+        signal_file=cfg.train.drain_signal_file, recorder=rec)
+    drain.install()
 
-    def _on_sigterm(signum, frame):
-        preempted["flag"] = True
-
-    try:
-        prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
-    except ValueError:           # non-main thread (tests)
-        prev_handler = None
+    # Straggler policy (elastic/straggler.py): the display cadence feeds
+    # this host's window step-time p50 into the live twin of obs_report
+    # --merge's skew rule; demotions ride the goodput snapshot.
+    straggler_policy = elastic.StragglerPolicy(
+        ratio=cfg.train.straggler_ratio,
+        window=cfg.train.straggler_window,
+        recommend_resize=cfg.train.straggler_resize, recorder=rec)
 
     # Multi-process: a maintenance event may signal only SOME workers; a
     # worker acting on its local flag alone would leave the rest wedged
@@ -737,6 +781,11 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                 # truth needs the profiler bridge / trace_dir) — no
                 # sync, no transfer, file write is line-buffered host IO
                 with rec.span("step", step=total_steps + 1):
+                    # host.slow chaos site: inflate THIS process's step
+                    # wall time (a persistently slow host for the
+                    # straggler policy); the sleep lands inside the step
+                    # span so the recorded skew is the injected one
+                    faults.maybe_hang("host.slow", default_sleep=0.05)
                     if guard_on:
                         state, loss, skipped = step_fn(state, video, text,
                                                        start)
@@ -874,6 +923,15 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                             and (opt_step - window) != opt_step0):
                         spike_detector.observe(elapsed * 1e3 / window,
                                                step=opt_step)
+                    # straggler feed: THIS host's window mean step wall
+                    # time, same first-window exclusion as the spike
+                    # detector (compile time is not skew).  Single-host
+                    # runs accumulate but never flag — skew needs a
+                    # second host to compare against.
+                    if window > 0 and (opt_step - window) != opt_step0:
+                        straggler_policy.observe(
+                            process_index, elapsed * 1e3 / window,
+                            step=opt_step)
                     if (profiler_capture is not None
                             and capture_requested["flag"]):
                         capture_requested["flag"] = False
@@ -951,6 +1009,10 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                   window = 0
                   timer.reset()
                   tick = time.time()
+                # one drain poll per optimizer step (host-side: a dict
+                # read + disarmed-fault check — the host.preempt
+                # occurrence count is therefore the step number)
+                local_drain = drain.poll(total_steps)
                 if multi:
                     # every process evaluates the collective at the SAME
                     # steps (total_steps advances in lockstep), so they
@@ -960,35 +1022,54 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                     stopping = False
                     if total_steps % sync_every == 0:
                         with jax.transfer_guard("allow"):
-                            stopping = any_preempted(preempted["flag"])
+                            stopping = any_preempted(local_drain)
                 else:
-                    stopping = preempted["flag"]
+                    stopping = local_drain
                 if stopping or (max_steps is not None
                                 and total_steps >= max_steps):
                   with jax.transfer_guard("allow"):  # checkpoint + exit
-                    if stopping:
-                        logger.log("SIGTERM — checkpointing and exiting"
-                                   + (" (cluster-coordinated)" if multi
-                                      else ""))
+                    drained = bool(stopping)
+                    if drained:
+                        logger.log(
+                            f"drain ({drain.source or 'cluster peer'}) — "
+                            "checkpointing and exiting"
+                            + (" (cluster-coordinated)" if multi else ""))
                     # label/force semantics: stop_save_label (module
                     # top); the planned twin handles per-stage epoch
                     # lengths.  Edge cases pinned in
                     # tests/test_resilience.py + test_train.py
                     label, force = stop_save_label_planned(
                         epoch, opt_step0 + total_steps, plan)
-                    with rec.span("ckpt.save", label=label, forced=force,
-                                  stage=stage_idx):
+                    # a drain's forced save is badput the preemption
+                    # caused: it lands in the ledger's drain bucket
+                    # (span INSTEAD of ckpt.save — overlapping both
+                    # would double-count against the sum-to-wall pin)
+                    with rec.span(
+                            "elastic.drain" if drained else "ckpt.save",
+                            label=label, forced=force, stage=stage_idx,
+                            **({"source": drain.source} if drained
+                               else {})):
                         manager.save(label, state, force=force)
                         manager.wait()
                     if process_index == 0:
+                        opt_step = opt_step0 + total_steps
                         curriculum.write_stage_stamp(
                             ckpt_dir, spec=cfg.train.curriculum,
                             stage_index=stage_idx,
                             stage=plan.stages[stage_idx],
-                            step=opt_step0 + total_steps)
+                            step=opt_step)
+                        seg_c, off_c = plan.locate(opt_step)
+                        elastic.write_elastic_stamp(
+                            ckpt_dir, mesh_shape=dict(mesh.shape),
+                            sharding_hash=(placement.hash if model_axis
+                                           else ""),
+                            step=opt_step, stage_index=stage_idx,
+                            batch_offset=seg_c.skip_batches + off_c,
+                            drained=drained)
                     last, skips = exit_metrics()
                     return TrainResult(state, total_steps, last,
-                                       skips, rollbacks, stage_idx)
+                                       skips, rollbacks, stage_idx,
+                                       drained)
                 if seg_done >= seg.n_steps:
                     break       # segment complete (stage boundary or
                                 # epoch tail) — drain + re-arm below
@@ -1007,17 +1088,28 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
                               stage=stage_idx):
                     manager.save(epoch + 1, state)
                 if process_index == 0:
+                    opt_step = opt_step0 + total_steps
                     curriculum.write_stage_stamp(
                         ckpt_dir, spec=cfg.train.curriculum,
                         stage_index=stage_idx,
                         stage=plan.stages[stage_idx],
-                        step=opt_step0 + total_steps)
+                        step=opt_step)
+                    # the topology sidecar rides EVERY save (the pair
+                    # must stay in lockstep — check_topology_resume
+                    # cross-checks their plan cursors on resume)
+                    seg_c, off_c = plan.locate(opt_step)
+                    elastic.write_elastic_stamp(
+                        ckpt_dir, mesh_shape=dict(mesh.shape),
+                        sharding_hash=(placement.hash if model_axis
+                                       else ""),
+                        step=opt_step, stage_index=stage_idx,
+                        batch_offset=seg_c.skip_batches + off_c,
+                        drained=False)
     finally:
         manager.wait()
         if cfg.train.faults:
             faults.disarm()     # a config-armed registry dies with the run
-        if prev_handler is not None:
-            signal.signal(signal.SIGTERM, prev_handler)
+        drain.uninstall()
         if prev_usr1 is not None:
             signal.signal(signal.SIGUSR1, prev_usr1)
         if profiler_capture is not None:
@@ -1026,11 +1118,12 @@ def run_training(cfg: Config, max_steps: Optional[int] = None) -> TrainResult:
         # per-run attribution (obs/goodput.py): partition this run's
         # wall time, export gauges + the GOODPUT snapshot — best-effort,
         # AFTER run.end so the ledger's wall covers the whole run
+        ledger_extra = dict(straggler_policy.ledger_extra())
+        if last_mfu is not None:
+            ledger_extra["mfu"] = round(last_mfu, 5)
         _finalize_goodput_ledger(
             rec, rec_path, run_id, process_index, reg, obs_dir,
-            logger.log,
-            extra=({"mfu": round(last_mfu, 5)}
-                   if last_mfu is not None else None))
+            logger.log, extra=ledger_extra or None)
         obs_spans.install(prev_rec)     # this run's stream detaches
         rec.close()
         obs_runctx.set_run_context(*prev_runctx)

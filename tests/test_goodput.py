@@ -85,6 +85,29 @@ class TestLedger:
         assert led.steps == 5
         assert 0 < led.goodput_fraction < 1
 
+    def test_elastic_drain_and_reshard_categories(self):
+        """ISSUE 20 satellite: elastic.drain / elastic.resume spans land
+        in their own drain / reshard buckets (used INSTEAD of ckpt.save /
+        ckpt.restore on the drain path — never alongside, which would
+        double-count), and the partition still sums to wall."""
+        recs = [_event("run.start", 0.0),
+                _span("elastic.resume", 0.5, 1.5, label="latest",
+                      from_mesh="{'data': 8}", to_mesh="{'data': 4}"),
+                _span("step", 2.0, 5.0, step=1),            # compile
+                _span("step", 7.0, 1.0, step=2),
+                _span("step", 8.0, 1.0, step=3),
+                _span("elastic.drain", 9.0, 2.0, label=1, forced=True,
+                      source="host.preempt"),
+                _event("run.end", 12.0)]
+        led = compute_ledger(recs)
+        cats = led.categories
+        assert cats["reshard"] == pytest.approx(1.5)
+        assert cats["drain"] == pytest.approx(2.0)
+        assert cats["checkpoint"] == 0.0
+        assert cats["compute"] == pytest.approx(2.0)
+        assert sum(cats.values()) == pytest.approx(led.wall_s)
+        assert set(cats) == set(CATEGORIES)
+
     def test_skipped_steps_reattributed_out_of_compute(self):
         recs = self._stream()
         recs.insert(-1, _event("display", 12.5, skipped_total=2))

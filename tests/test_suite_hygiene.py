@@ -412,6 +412,30 @@ def test_quant_gates_exist_and_stay_tier1():
             f"{fname}::{slow}")
 
 
+# elastic gates (ISSUE 20): the drain -> cross-topology-resume chaos
+# chain (8-way -> 4x2 -> 4-way with loss-trajectory continuity), the
+# stamp refusals, the drained-save atomicity regression and the
+# straggler policy are the regression fence for elastic pod training.
+# Same rule as every other subsystem gate: tier-1, never @slow, never
+# vanished.
+_ELASTIC_GATES = ("test_elastic.py",)
+
+
+def test_elastic_gates_exist_and_stay_tier1():
+    for fname in _ELASTIC_GATES:
+        path = os.path.join(_TESTS, fname)
+        assert os.path.exists(path), f"elastic gate {fname} is missing"
+        src = open(path).read()
+        tests = list(_iter_tests(ast.parse(src)))
+        assert tests, f"{fname} defines no tests"
+        slow = [node.name for node, class_slow in tests
+                if _is_slow_marked(node, class_slow)]
+        assert not slow, (
+            "elastic tests must be tier-1/CPU-safe, never @slow "
+            "(they are the preemption/topology-change regression fence): "
+            f"{fname}::{slow}")
+
+
 def test_fast_child_exemptions_stay_real():
     """Every _FAST_CHILD_EXEMPT entry must name a test that still
     exists — a stale exemption is a hole the audit thinks it covers."""
